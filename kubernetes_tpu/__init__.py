@@ -1,0 +1,22 @@
+"""tpu-sched: a TPU-native cluster-scheduling framework.
+
+A ground-up redesign of the capabilities of Kubernetes' kube-scheduler
+(reference: longhao54/kubernetes ~v1.18) for TPU hardware. Instead of the
+reference's serialized per-pod ``scheduleOne`` loop
+(/root/reference/pkg/scheduler/scheduler.go:548), pending pods and the node
+snapshot are lifted into pod x node tensors and placement is solved as a
+batched assignment problem in JAX/XLA/Pallas:
+
+- Filter plugins  -> vectorized feasibility masks          (ops/masks.py)
+- Score plugins   -> score matrices                        (ops/scores.py)
+- scheduleOne     -> lax.scan greedy / auction assignment  (ops/assignment.py)
+- NodeInfo cache  -> incrementally-updated NodeTensor      (tensors/)
+
+The scheduling-framework extension-point contract (QueueSort, PreFilter,
+Filter, PreScore, Score, Reserve, Permit, PreBind, Bind, PostBind, Unreserve
+-- reference framework/v1alpha1/interface.go) is preserved verbatim so the
+TPU solver ships as a selectable profile, with the sequential host path kept
+as the correctness oracle.
+"""
+
+__version__ = "0.1.0"

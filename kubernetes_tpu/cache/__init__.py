@@ -1,0 +1,11 @@
+"""Scheduler cache: authoritative in-memory cluster state.
+
+Reference: /root/reference/pkg/scheduler/internal/cache/ and
+/root/reference/pkg/scheduler/nodeinfo/.
+"""
+
+from kubernetes_tpu.cache.node_info import NodeInfo, Resource, new_resource
+from kubernetes_tpu.cache.cache import SchedulerCache
+from kubernetes_tpu.cache.snapshot import Snapshot
+
+__all__ = ["NodeInfo", "Resource", "SchedulerCache", "Snapshot", "new_resource"]

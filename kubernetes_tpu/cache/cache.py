@@ -1,0 +1,240 @@
+"""Scheduler cache with assumed-pod overlay and incremental snapshots.
+
+Reference: /root/reference/pkg/scheduler/internal/cache/cache.go:59
+(schedulerCache), AssumePod :344, UpdateSnapshot :203, pod state machine
+interface.go:16-58 (Initial -> Assumed -> Added -> Deleted, with TTL expiry
+of assumed pods that finished binding).
+
+The incremental snapshot uses per-NodeInfo generation counters: only
+NodeInfos whose generation advanced past the snapshot's generation are
+re-cloned (reference orders nodes in a doubly-linked list by modification
+generation, cache.go:53; here a generation compare over the map achieves the
+same "copy only changed nodes" property).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.cache.node_info import NodeInfo, next_generation
+from kubernetes_tpu.cache.snapshot import Snapshot
+
+DEFAULT_ASSUME_TTL_SECONDS = 30.0  # reference scheduler.go:240
+
+
+@dataclass
+class _PodState:
+    pod: Pod
+    assumed: bool = False
+    binding_finished: bool = False
+    deadline: Optional[float] = None  # absolute expiry, set by finish_binding
+
+
+class SchedulerCache:
+    def __init__(
+        self,
+        ttl_seconds: float = DEFAULT_ASSUME_TTL_SECONDS,
+        now=time.monotonic,
+    ) -> None:
+        self._lock = threading.RLock()
+        self._ttl = ttl_seconds
+        self._now = now
+        self._nodes: Dict[str, NodeInfo] = {}
+        self._pod_states: Dict[str, _PodState] = {}  # key: pod uid
+        self._assumed_pods: Dict[str, bool] = {}
+
+    # -- assume / bind lifecycle (cache.go:344-) ----------------------------
+
+    def assume_pod(self, pod: Pod) -> None:
+        key = pod.metadata.uid
+        with self._lock:
+            if key in self._pod_states:
+                raise KeyError(f"pod {pod.key()} is already in the cache")
+            self._add_pod_to_node(pod)
+            self._pod_states[key] = _PodState(pod=pod, assumed=True)
+            self._assumed_pods[key] = True
+
+    def finish_binding(self, pod: Pod) -> None:
+        key = pod.metadata.uid
+        with self._lock:
+            state = self._pod_states.get(key)
+            if state and state.assumed:
+                state.binding_finished = True
+                state.deadline = self._now() + self._ttl
+
+    def forget_pod(self, pod: Pod) -> None:
+        key = pod.metadata.uid
+        with self._lock:
+            state = self._pod_states.get(key)
+            if state is None:
+                return
+            if state.assumed and state.pod.spec.node_name != pod.spec.node_name:
+                # Reference cache.go:399: forgetting a pod assumed to a
+                # different node signals scheduler bookkeeping corruption.
+                raise ValueError(
+                    f"pod {pod.key()} was assumed on "
+                    f"{state.pod.spec.node_name} but forgotten on "
+                    f"{pod.spec.node_name}"
+                )
+            if not state.assumed:
+                raise ValueError(f"pod {pod.key()} was added, not assumed")
+            self._remove_pod_from_node(state.pod)
+            del self._pod_states[key]
+            self._assumed_pods.pop(key, None)
+
+    def is_assumed_pod(self, pod: Pod) -> bool:
+        with self._lock:
+            return self._assumed_pods.get(pod.metadata.uid, False)
+
+    # -- confirmed pod events (informer-driven) -----------------------------
+
+    def add_pod(self, pod: Pod) -> None:
+        key = pod.metadata.uid
+        with self._lock:
+            state = self._pod_states.get(key)
+            if state is not None and state.assumed:
+                # Confirmation of an assumed pod. If the actual node differs,
+                # move it (reference cache.go:419 "was assumed to a different
+                # node": remove then re-add).
+                if state.pod.spec.node_name != pod.spec.node_name:
+                    self._remove_pod_from_node(state.pod)
+                    self._add_pod_to_node(pod)
+                self._pod_states[key] = _PodState(pod=pod, assumed=False)
+                self._assumed_pods.pop(key, None)
+                return
+            if state is not None:
+                raise KeyError(f"pod {pod.key()} already added")
+            self._add_pod_to_node(pod)
+            self._pod_states[key] = _PodState(pod=pod, assumed=False)
+
+    def update_pod(self, old: Pod, new: Pod) -> None:
+        with self._lock:
+            state = self._pod_states.get(old.metadata.uid)
+            if state is None or state.assumed:
+                raise KeyError(f"pod {old.key()} not added")
+            self._remove_pod_from_node(state.pod)
+            self._add_pod_to_node(new)
+            self._pod_states[new.metadata.uid] = _PodState(pod=new, assumed=False)
+
+    def remove_pod(self, pod: Pod) -> None:
+        key = pod.metadata.uid
+        with self._lock:
+            state = self._pod_states.get(key)
+            if state is None:
+                return
+            self._remove_pod_from_node(state.pod)
+            del self._pod_states[key]
+            self._assumed_pods.pop(key, None)
+
+    def get_pod(self, pod: Pod) -> Optional[Pod]:
+        with self._lock:
+            state = self._pod_states.get(pod.metadata.uid)
+            return state.pod if state else None
+
+    # -- node events --------------------------------------------------------
+
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            ni = self._nodes.get(node.metadata.name)
+            if ni is None:
+                self._nodes[node.metadata.name] = NodeInfo(node)
+            else:
+                ni.set_node(node)
+
+    def update_node(self, old: Node, new: Node) -> None:
+        self.add_node(new)
+
+    def remove_node(self, node: Node) -> None:
+        with self._lock:
+            ni = self._nodes.pop(node.metadata.name, None)
+            if ni is not None and ni.pods:
+                # Keep a nodeless NodeInfo while pods remain (reference
+                # removes the node object but keeps pod accounting;
+                # cache.go:582). We keep the entry with node=None.
+                ni.node = None
+                ni.generation = next_generation()
+                self._nodes[node.metadata.name] = ni
+
+    def node_count(self) -> int:
+        with self._lock:
+            return sum(1 for ni in self._nodes.values() if ni.node is not None)
+
+    def pod_count(self) -> int:
+        with self._lock:
+            return sum(len(ni.pods) for ni in self._nodes.values())
+
+    # -- expiry (reference cleanupAssumedPods, run every 1s) ----------------
+
+    def cleanup_expired_assumed_pods(self) -> List[Pod]:
+        """Expire assumed pods whose binding finished > TTL ago. Returns the
+        expired pods so the caller can requeue/log them."""
+        expired: List[Pod] = []
+        now = self._now()
+        with self._lock:
+            for key in list(self._assumed_pods):
+                state = self._pod_states[key]
+                if state.binding_finished and state.deadline is not None:
+                    if now >= state.deadline:
+                        expired.append(state.pod)
+                        self._remove_pod_from_node(state.pod)
+                        del self._pod_states[key]
+                        del self._assumed_pods[key]
+        return expired
+
+    # -- snapshot (cache.go:203 UpdateSnapshot) -----------------------------
+
+    def update_snapshot(self, snapshot: Snapshot) -> Snapshot:
+        """Incrementally refresh ``snapshot`` in place: clone only NodeInfos
+        whose generation advanced; drop deleted nodes; refresh derived
+        lists."""
+        with self._lock:
+            max_gen = snapshot.generation
+            changed = False
+            for name, ni in self._nodes.items():
+                if ni.generation > snapshot.generation:
+                    snapshot.node_info_map[name] = ni.clone()
+                    changed = True
+                    if ni.generation > max_gen:
+                        max_gen = ni.generation
+            stale = set(snapshot.node_info_map) - set(self._nodes)
+            for name in stale:
+                del snapshot.node_info_map[name]
+                changed = True
+            if changed:
+                snapshot.refresh_lists()
+            snapshot.generation = max_gen
+            return snapshot
+
+    # -- debugger support (internal/cache/debugger) -------------------------
+
+    def dump(self) -> Dict[str, List[str]]:
+        with self._lock:
+            return {
+                name: [p.key() for p in ni.pods]
+                for name, ni in self._nodes.items()
+            }
+
+    # -- internals ----------------------------------------------------------
+
+    def _add_pod_to_node(self, pod: Pod) -> None:
+        name = pod.spec.node_name
+        ni = self._nodes.get(name)
+        if ni is None:
+            # Pod observed before its node: keep a nodeless NodeInfo
+            # (reference cache.go:514 addPod creates the entry).
+            ni = NodeInfo()
+            self._nodes[name] = ni
+        ni.add_pod(pod)
+
+    def _remove_pod_from_node(self, pod: Pod) -> None:
+        name = pod.spec.node_name
+        ni = self._nodes.get(name)
+        if ni is None:
+            return
+        ni.remove_pod(pod)
+        if ni.node is None and not ni.pods:
+            del self._nodes[name]

@@ -1,0 +1,235 @@
+"""NodeInfo: the per-node accumulator every filter/score reads.
+
+Reference: /root/reference/pkg/scheduler/nodeinfo/node_info.go:47 (NodeInfo),
+:143 (Resource), host_ports.go (HostPortInfo). This is exactly the structure
+that gets lifted into the ``[N_nodes, R]`` resource tensor by
+``kubernetes_tpu.tensors.node_tensor``.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from kubernetes_tpu.api.types import (
+    RESOURCE_CPU,
+    RESOURCE_EPHEMERAL_STORAGE,
+    RESOURCE_MEMORY,
+    RESOURCE_PODS,
+    Node,
+    Pod,
+    ResourceList,
+    pod_resource_requests,
+)
+
+# Reference pkg/scheduler/util/non_zero.go: pods with no requests still count
+# a default footprint toward spreading heuristics (NOT toward Fit).
+DEFAULT_MILLI_CPU_REQUEST = 100  # 0.1 core
+DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024  # 200 MiB
+
+_generation = itertools.count(1)
+
+
+def next_generation() -> int:
+    return next(_generation)
+
+
+@dataclass
+class Resource:
+    """Aggregated resource vector (reference node_info.go:143)."""
+
+    milli_cpu: int = 0
+    memory: int = 0
+    ephemeral_storage: int = 0
+    allowed_pod_number: int = 0
+    scalar: Dict[str, int] = field(default_factory=dict)
+
+    def clone(self) -> "Resource":
+        return Resource(
+            self.milli_cpu,
+            self.memory,
+            self.ephemeral_storage,
+            self.allowed_pod_number,
+            dict(self.scalar),
+        )
+
+    def add(self, rl: ResourceList) -> None:
+        for name, qty in rl.items():
+            if name == RESOURCE_CPU:
+                self.milli_cpu += qty
+            elif name == RESOURCE_MEMORY:
+                self.memory += qty
+            elif name == RESOURCE_EPHEMERAL_STORAGE:
+                self.ephemeral_storage += qty
+            elif name == RESOURCE_PODS:
+                self.allowed_pod_number += qty
+            else:
+                self.scalar[name] = self.scalar.get(name, 0) + qty
+
+    def sub(self, rl: ResourceList) -> None:
+        for name, qty in rl.items():
+            if name == RESOURCE_CPU:
+                self.milli_cpu -= qty
+            elif name == RESOURCE_MEMORY:
+                self.memory -= qty
+            elif name == RESOURCE_EPHEMERAL_STORAGE:
+                self.ephemeral_storage -= qty
+            elif name == RESOURCE_PODS:
+                self.allowed_pod_number -= qty
+            else:
+                self.scalar[name] = self.scalar.get(name, 0) - qty
+
+
+def new_resource(rl: ResourceList) -> Resource:
+    r = Resource()
+    r.add(rl)
+    return r
+
+
+def non_zero_requests(pod: Pod) -> Tuple[int, int]:
+    """(milliCPU, memory) with per-container defaults applied
+    (reference util/non_zero.go GetNonzeroRequests)."""
+    cpu = 0
+    mem = 0
+    for c in pod.spec.containers:
+        ccpu = c.resources.requests.get(RESOURCE_CPU, 0)
+        cmem = c.resources.requests.get(RESOURCE_MEMORY, 0)
+        cpu += ccpu if ccpu else DEFAULT_MILLI_CPU_REQUEST
+        mem += cmem if cmem else DEFAULT_MEMORY_REQUEST
+    return cpu, mem
+
+
+def pod_has_affinity_constraints(pod: Pod) -> bool:
+    a = pod.spec.affinity
+    return a is not None and (
+        a.pod_affinity is not None or a.pod_anti_affinity is not None
+    )
+
+
+def pod_host_ports(pod: Pod) -> List[Tuple[str, str, int]]:
+    """[(ip, protocol, port)] for every container hostPort != 0."""
+    out = []
+    for c in pod.spec.containers:
+        for p in c.ports:
+            if p.host_port:
+                ip = p.host_ip or "0.0.0.0"
+                out.append((ip, p.protocol or "TCP", p.host_port))
+    return out
+
+
+class HostPortInfo:
+    """Port-conflict bookkeeping (reference host_ports.go).
+
+    A (ip, proto, port) conflicts with an existing entry when ports and
+    protocols are equal and either ip is 0.0.0.0 or the ips are equal.
+    """
+
+    def __init__(self) -> None:
+        self.ports: Set[Tuple[str, str, int]] = set()
+
+    def clone(self) -> "HostPortInfo":
+        hp = HostPortInfo()
+        hp.ports = set(self.ports)
+        return hp
+
+    def add(self, ip: str, proto: str, port: int) -> None:
+        self.ports.add((ip, proto, port))
+
+    def remove(self, ip: str, proto: str, port: int) -> None:
+        self.ports.discard((ip, proto, port))
+
+    def conflicts(self, ip: str, proto: str, port: int) -> bool:
+        for eip, eproto, eport in self.ports:
+            if eport != port or eproto != proto:
+                continue
+            if ip == "0.0.0.0" or eip == "0.0.0.0" or eip == ip:
+                return True
+        return False
+
+
+class NodeInfo:
+    """Aggregated per-node state (reference node_info.go:47)."""
+
+    def __init__(self, node: Optional[Node] = None) -> None:
+        self.node: Optional[Node] = node
+        self.pods: List[Pod] = []
+        self.pods_with_affinity: List[Pod] = []
+        self.used_ports = HostPortInfo()
+        self.requested = Resource()
+        self.non_zero_requested = Resource()
+        self.allocatable = Resource()
+        self.image_states: Dict[str, int] = {}  # image name -> size bytes
+        self.generation: int = next_generation()
+        if node is not None:
+            self.set_node(node)
+
+    # -- node ---------------------------------------------------------------
+
+    def set_node(self, node: Node) -> None:
+        self.node = node
+        self.allocatable = new_resource(node.status.allocatable)
+        self.image_states = {
+            name: img.size_bytes for img in node.status.images for name in img.names
+        }
+        self.generation = next_generation()
+
+    @property
+    def node_name(self) -> str:
+        return self.node.metadata.name if self.node else ""
+
+    # -- pods ---------------------------------------------------------------
+
+    def add_pod(self, pod: Pod) -> None:
+        req = pod_resource_requests(pod)
+        self.requested.add(req)
+        cpu, mem = non_zero_requests(pod)
+        self.non_zero_requested.milli_cpu += cpu
+        self.non_zero_requested.memory += mem
+        self.pods.append(pod)
+        if pod_has_affinity_constraints(pod):
+            self.pods_with_affinity.append(pod)
+        for ip, proto, port in pod_host_ports(pod):
+            self.used_ports.add(ip, proto, port)
+        self.generation = next_generation()
+
+    def remove_pod(self, pod: Pod) -> bool:
+        for i, p in enumerate(self.pods):
+            if p.metadata.uid == pod.metadata.uid:
+                del self.pods[i]
+                break
+        else:
+            return False
+        self.pods_with_affinity = [
+            p for p in self.pods_with_affinity if p.metadata.uid != pod.metadata.uid
+        ]
+        req = pod_resource_requests(pod)
+        self.requested.sub(req)
+        cpu, mem = non_zero_requests(pod)
+        self.non_zero_requested.milli_cpu -= cpu
+        self.non_zero_requested.memory -= mem
+        for ip, proto, port in pod_host_ports(pod):
+            self.used_ports.remove(ip, proto, port)
+        self.generation = next_generation()
+        return True
+
+    # -- snapshot support ---------------------------------------------------
+
+    def clone(self) -> "NodeInfo":
+        ni = NodeInfo.__new__(NodeInfo)
+        ni.node = self.node
+        ni.pods = list(self.pods)
+        ni.pods_with_affinity = list(self.pods_with_affinity)
+        ni.used_ports = self.used_ports.clone()
+        ni.requested = self.requested.clone()
+        ni.non_zero_requested = self.non_zero_requested.clone()
+        ni.allocatable = self.allocatable.clone()
+        ni.image_states = dict(self.image_states)
+        ni.generation = self.generation
+        return ni
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"NodeInfo(node={self.node_name!r}, pods={len(self.pods)}, "
+            f"requested=cpu:{self.requested.milli_cpu}m mem:{self.requested.memory})"
+        )

@@ -1,0 +1,63 @@
+"""Snapshot: an immutable-for-the-cycle view of cluster state.
+
+Reference: /root/reference/pkg/scheduler/internal/cache/snapshot.go:31 and
+pkg/scheduler/listers/listers.go (SharedLister). The snapshot carries both
+the object view (NodeInfo list for the host/oracle path) and, lazily, the
+packed tensor view consumed by the TPU solver
+(kubernetes_tpu.tensors.node_tensor).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.cache.node_info import NodeInfo, pod_has_affinity_constraints
+
+
+class Snapshot:
+    def __init__(self, node_infos: Optional[Dict[str, NodeInfo]] = None) -> None:
+        self.node_info_map: Dict[str, NodeInfo] = node_infos or {}
+        # Stable iteration order for the cycle (reference keeps nodeInfoList).
+        self.node_info_list: List[NodeInfo] = [
+            ni for ni in self.node_info_map.values() if ni.node is not None
+        ]
+        self.have_pods_with_affinity_list: List[NodeInfo] = [
+            ni for ni in self.node_info_list if ni.pods_with_affinity
+        ]
+        self.generation: int = 0
+
+    # SharedLister surface ---------------------------------------------------
+
+    def list_node_infos(self) -> List[NodeInfo]:
+        return self.node_info_list
+
+    def get_node_info(self, name: str) -> Optional[NodeInfo]:
+        return self.node_info_map.get(name)
+
+    def list_pods(self) -> List[Pod]:
+        return [p for ni in self.node_info_list for p in ni.pods]
+
+    def num_nodes(self) -> int:
+        return len(self.node_info_list)
+
+    def refresh_lists(self) -> None:
+        self.node_info_list = [
+            ni for ni in self.node_info_map.values() if ni.node is not None
+        ]
+        self.have_pods_with_affinity_list = [
+            ni for ni in self.node_info_list if ni.pods_with_affinity
+        ]
+
+
+def new_snapshot(pods: Iterable[Pod], nodes: Iterable[Node]) -> Snapshot:
+    """Test/bench helper, reference snapshot.go:51 NewSnapshot."""
+    infos: Dict[str, NodeInfo] = {}
+    for node in nodes:
+        infos[node.metadata.name] = NodeInfo(node)
+    for pod in pods:
+        name = pod.spec.node_name
+        if name and name in infos:
+            infos[name].add_pod(pod)
+    snap = Snapshot(infos)
+    return snap

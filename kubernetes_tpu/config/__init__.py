@@ -1,0 +1,15 @@
+from kubernetes_tpu.config.types import (
+    KubeSchedulerConfiguration,
+    KubeSchedulerProfile,
+    Plugin as PluginRef,
+    PluginSet,
+    Plugins,
+)
+
+__all__ = [
+    "KubeSchedulerConfiguration",
+    "KubeSchedulerProfile",
+    "PluginRef",
+    "PluginSet",
+    "Plugins",
+]

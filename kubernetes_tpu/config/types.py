@@ -1,0 +1,118 @@
+"""Scheduler component configuration.
+
+Reference: /root/reference/pkg/scheduler/apis/config/types.go
+(KubeSchedulerConfiguration :46, KubeSchedulerProfile :111, Plugins :178,
+Plugin/PluginSet :230-247) and the v1alpha2 wire format in
+staging/src/k8s.io/kube-scheduler/config/v1alpha2/types.go:94.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+DEFAULT_PERCENTAGE_OF_NODES_TO_SCORE = 0  # 0 => adaptive (types.go:250)
+MIN_FEASIBLE_NODES_TO_FIND = 100  # generic_scheduler.go:57
+MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5  # generic_scheduler.go:62
+
+DEFAULT_POD_INITIAL_BACKOFF_SECONDS = 1.0  # types.go:95
+DEFAULT_POD_MAX_BACKOFF_SECONDS = 10.0  # types.go:101
+
+
+@dataclass
+class Plugin:
+    """An enabled plugin reference with an optional weight (Score only)."""
+
+    name: str
+    weight: int = 1
+
+
+@dataclass
+class PluginSet:
+    enabled: List[Plugin] = field(default_factory=list)
+    disabled: List[Plugin] = field(default_factory=list)  # name "*" disables all
+
+
+@dataclass
+class Plugins:
+    """Per-extension-point enable/disable lists (types.go:178)."""
+
+    queue_sort: PluginSet = field(default_factory=PluginSet)
+    pre_filter: PluginSet = field(default_factory=PluginSet)
+    filter: PluginSet = field(default_factory=PluginSet)
+    pre_score: PluginSet = field(default_factory=PluginSet)
+    score: PluginSet = field(default_factory=PluginSet)
+    reserve: PluginSet = field(default_factory=PluginSet)
+    permit: PluginSet = field(default_factory=PluginSet)
+    pre_bind: PluginSet = field(default_factory=PluginSet)
+    bind: PluginSet = field(default_factory=PluginSet)
+    post_bind: PluginSet = field(default_factory=PluginSet)
+    unreserve: PluginSet = field(default_factory=PluginSet)
+
+    EXTENSION_POINTS = (
+        "queue_sort",
+        "pre_filter",
+        "filter",
+        "pre_score",
+        "score",
+        "reserve",
+        "permit",
+        "pre_bind",
+        "bind",
+        "post_bind",
+        "unreserve",
+    )
+
+    def apply(self, custom: Optional["Plugins"]) -> "Plugins":
+        """Merge a profile's overrides onto defaults: for each extension
+        point, custom enabled plugins are appended after defaults that were
+        not disabled (reference apis/config/v1alpha2 mergePlugins)."""
+        if custom is None:
+            return self
+        out = Plugins()
+        for point in self.EXTENSION_POINTS:
+            default_set: PluginSet = getattr(self, point)
+            custom_set: PluginSet = getattr(custom, point)
+            disabled = {p.name for p in custom_set.disabled}
+            if "*" in disabled:
+                enabled = []
+            else:
+                enabled = [p for p in default_set.enabled if p.name not in disabled]
+            enabled = enabled + list(custom_set.enabled)
+            setattr(out, point, PluginSet(enabled=enabled))
+        return out
+
+
+@dataclass
+class KubeSchedulerProfile:
+    """types.go:111."""
+
+    scheduler_name: str = "default-scheduler"
+    plugins: Optional[Plugins] = None
+    plugin_config: Dict[str, Any] = field(default_factory=dict)  # plugin -> args
+
+
+@dataclass
+class LeaderElectionConfiguration:
+    leader_elect: bool = False
+    lease_duration_seconds: float = 15.0
+    renew_deadline_seconds: float = 10.0
+    retry_period_seconds: float = 2.0
+    resource_name: str = "kube-scheduler"
+    resource_namespace: str = "kube-system"
+
+
+@dataclass
+class KubeSchedulerConfiguration:
+    """types.go:46."""
+
+    profiles: List[KubeSchedulerProfile] = field(default_factory=list)
+    percentage_of_nodes_to_score: int = DEFAULT_PERCENTAGE_OF_NODES_TO_SCORE
+    pod_initial_backoff_seconds: float = DEFAULT_POD_INITIAL_BACKOFF_SECONDS
+    pod_max_backoff_seconds: float = DEFAULT_POD_MAX_BACKOFF_SECONDS
+    leader_election: LeaderElectionConfiguration = field(
+        default_factory=LeaderElectionConfiguration
+    )
+    health_bind_address: str = ""
+    metrics_bind_address: str = ""
+    feature_gates: Dict[str, bool] = field(default_factory=dict)

@@ -1,0 +1,7 @@
+"""Scheduling queue (reference pkg/scheduler/internal/queue/)."""
+
+from kubernetes_tpu.queue.heap import Heap
+from kubernetes_tpu.queue.scheduling_queue import PriorityQueue
+from kubernetes_tpu.queue import events
+
+__all__ = ["Heap", "PriorityQueue", "events"]

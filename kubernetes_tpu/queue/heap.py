@@ -1,0 +1,110 @@
+"""Keyed binary heap (reference pkg/scheduler/internal/heap/heap.go).
+
+A min-heap ordered by a user-supplied less(a, b) function, with O(1) lookup
+and O(log n) update/delete by key -- backs both activeQ and podBackoffQ.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Heap:
+    def __init__(self, key_func: Callable[[Any], str], less: Callable[[Any, Any], bool]):
+        self._key = key_func
+        self._less = less
+        self._items: List[Any] = []
+        self._index: Dict[str, int] = {}
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._index
+
+    def get_by_key(self, key: str) -> Optional[Any]:
+        i = self._index.get(key)
+        return self._items[i] if i is not None else None
+
+    def add(self, obj: Any) -> None:
+        """Insert or overwrite-and-reheapify (reference heap.go Add)."""
+        key = self._key(obj)
+        i = self._index.get(key)
+        if i is not None:
+            self._items[i] = obj
+            self._fix(i)
+        else:
+            self._items.append(obj)
+            self._index[key] = len(self._items) - 1
+            self._up(len(self._items) - 1)
+
+    def add_if_not_present(self, obj: Any) -> None:
+        if self._key(obj) not in self._index:
+            self.add(obj)
+
+    def update(self, obj: Any) -> None:
+        self.add(obj)
+
+    def delete(self, obj: Any) -> None:
+        self.delete_by_key(self._key(obj))
+
+    def delete_by_key(self, key: str) -> None:
+        i = self._index.get(key)
+        if i is None:
+            return
+        last = len(self._items) - 1
+        self._swap(i, last)
+        del self._index[key]
+        self._items.pop()
+        if i != last:
+            self._fix(i)
+
+    def peek(self) -> Optional[Any]:
+        return self._items[0] if self._items else None
+
+    def pop(self) -> Any:
+        if not self._items:
+            raise IndexError("heap is empty")
+        top = self._items[0]
+        self.delete_by_key(self._key(top))
+        return top
+
+    def list(self) -> List[Any]:
+        return list(self._items)
+
+    # -- sift ---------------------------------------------------------------
+
+    def _swap(self, i: int, j: int) -> None:
+        if i == j:
+            return
+        items = self._items
+        items[i], items[j] = items[j], items[i]
+        self._index[self._key(items[i])] = i
+        self._index[self._key(items[j])] = j
+
+    def _up(self, i: int) -> None:
+        while i > 0:
+            parent = (i - 1) // 2
+            if self._less(self._items[i], self._items[parent]):
+                self._swap(i, parent)
+                i = parent
+            else:
+                break
+
+    def _down(self, i: int) -> None:
+        n = len(self._items)
+        while True:
+            left, right = 2 * i + 1, 2 * i + 2
+            smallest = i
+            if left < n and self._less(self._items[left], self._items[smallest]):
+                smallest = left
+            if right < n and self._less(self._items[right], self._items[smallest]):
+                smallest = right
+            if smallest == i:
+                return
+            self._swap(i, smallest)
+            i = smallest
+
+    def _fix(self, i: int) -> None:
+        self._up(i)
+        self._down(i)

@@ -1,0 +1,354 @@
+"""PriorityQueue: activeQ / podBackoffQ / unschedulableQ with cycle counters.
+
+Reference: /root/reference/pkg/scheduler/internal/queue/scheduling_queue.go
+(PriorityQueue :118, Pop :372, AddUnschedulableIfNotPresent :290,
+MoveAllToActiveOrBackoffQueue :494, backoff calc :643, flush loops
+:234-237, nominatedPodMap :720).
+
+TPU extension: ``pop_batch(max_size)`` drains up to B pods per solver step
+instead of one -- the activeQ drain *is* the batch (SURVEY.md section 2.1).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.framework.interface import PodInfo
+from kubernetes_tpu.queue import events
+from kubernetes_tpu.queue.heap import Heap
+
+DEFAULT_POD_INITIAL_BACKOFF = 1.0  # seconds
+DEFAULT_POD_MAX_BACKOFF = 10.0
+UNSCHEDULABLE_Q_TIME_INTERVAL = 60.0  # scheduling_queue.go:62
+
+
+def _pod_key(pod: Pod) -> str:
+    return f"{pod.metadata.namespace}/{pod.metadata.name}"
+
+
+def _info_key(pi: PodInfo) -> str:
+    return _pod_key(pi.pod)
+
+
+class _NominatedPodMap:
+    """Reference scheduling_queue.go:720."""
+
+    def __init__(self) -> None:
+        self.nominated_pods: Dict[str, List[Pod]] = {}  # node -> pods
+        self.nominated_pod_to_node: Dict[str, str] = {}  # uid -> node
+
+    def add(self, pod: Pod, node_name: str) -> None:
+        self.delete(pod)
+        node = node_name or pod.status.nominated_node_name
+        if not node:
+            return
+        self.nominated_pod_to_node[pod.metadata.uid] = node
+        self.nominated_pods.setdefault(node, []).append(pod)
+
+    def delete(self, pod: Pod) -> None:
+        node = self.nominated_pod_to_node.pop(pod.metadata.uid, None)
+        if node is None:
+            return
+        pods = self.nominated_pods.get(node, [])
+        self.nominated_pods[node] = [
+            p for p in pods if p.metadata.uid != pod.metadata.uid
+        ]
+        if not self.nominated_pods[node]:
+            del self.nominated_pods[node]
+
+    def pods_for_node(self, node_name: str) -> List[Pod]:
+        return list(self.nominated_pods.get(node_name, []))
+
+
+class PriorityQueue:
+    def __init__(
+        self,
+        less_func: Callable[[PodInfo, PodInfo], bool],
+        pod_initial_backoff: float = DEFAULT_POD_INITIAL_BACKOFF,
+        pod_max_backoff: float = DEFAULT_POD_MAX_BACKOFF,
+        now: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self._now = now
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._initial_backoff = pod_initial_backoff
+        self._max_backoff = pod_max_backoff
+
+        self.active_q = Heap(_info_key, less_func)
+        self.pod_backoff_q = Heap(
+            _info_key,
+            lambda a, b: self._backoff_time(a) < self._backoff_time(b),
+        )
+        self.unschedulable_q: Dict[str, PodInfo] = {}
+        self.nominated_pods = _NominatedPodMap()
+
+        self.scheduling_cycle = 0
+        self.move_request_cycle = 0
+        self._closed = False
+
+    # -- backoff ------------------------------------------------------------
+
+    def _backoff_duration(self, pi: PodInfo) -> float:
+        """Exponential: initial * 2^attempts capped at max
+        (reference :643 calculateBackoffDuration)."""
+        duration = self._initial_backoff
+        for _ in range(1, pi.attempts):
+            duration *= 2
+            if duration >= self._max_backoff:
+                return self._max_backoff
+        return duration
+
+    def _backoff_time(self, pi: PodInfo) -> float:
+        return pi.timestamp + self._backoff_duration(pi)
+
+    def _is_backing_off(self, pi: PodInfo) -> bool:
+        return self._backoff_time(pi) > self._now()
+
+    # -- add paths ----------------------------------------------------------
+
+    def add(self, pod: Pod) -> None:
+        """New pending pod (reference :246 Add)."""
+        with self._cond:
+            pi = PodInfo(pod, self._now())
+            self.active_q.add(pi)
+            self.unschedulable_q.pop(_pod_key(pod), None)
+            self.pod_backoff_q.delete_by_key(_pod_key(pod))
+            self.nominated_pods.add(pod, "")
+            self._cond.notify()
+
+    def add_unschedulable_if_not_present(
+        self, pi: PodInfo, pod_scheduling_cycle: int
+    ) -> None:
+        """Failed pod back into the queue (reference :290). A move request
+        during this pod's scheduling attempt sends it to backoff instead of
+        unschedulableQ -- the lost-wakeup guard."""
+        with self._cond:
+            key = _info_key(pi)
+            if key in self.unschedulable_q:
+                raise KeyError(f"pod {key} is already in the unschedulable queue")
+            if key in self.active_q or key in self.pod_backoff_q:
+                raise KeyError(f"pod {key} is already queued")
+            pi.timestamp = self._now()
+            if self.move_request_cycle >= pod_scheduling_cycle:
+                self.pod_backoff_q.add(pi)
+            else:
+                self.unschedulable_q[key] = pi
+            self.nominated_pods.add(pi.pod, "")
+            self._cond.notify()
+
+    def update(self, old_pod: Optional[Pod], new_pod: Pod) -> None:
+        """Reference :417: in active/backoff -> update in place; in
+        unschedulableQ -> move to activeQ if the update may make it
+        schedulable (we conservatively always move, matching
+        isPodUpdated=true paths)."""
+        with self._cond:
+            key = _pod_key(new_pod)
+            existing = self.active_q.get_by_key(key)
+            if existing is not None:
+                self.nominated_pods.add(new_pod, "")
+                existing.pod = new_pod
+                self.active_q.update(existing)
+                self._cond.notify()
+                return
+            existing = self.pod_backoff_q.get_by_key(key)
+            if existing is not None:
+                self.nominated_pods.add(new_pod, "")
+                existing.pod = new_pod
+                self.pod_backoff_q.update(existing)
+                return
+            pi = self.unschedulable_q.get(key)
+            if pi is not None:
+                self.nominated_pods.add(new_pod, "")
+                pi.pod = new_pod
+                if self._is_backing_off(pi):
+                    del self.unschedulable_q[key]
+                    self.pod_backoff_q.add(pi)
+                else:
+                    del self.unschedulable_q[key]
+                    self.active_q.add(pi)
+                    self._cond.notify()
+                return
+            self.add(new_pod)
+
+    def delete(self, pod: Pod) -> None:
+        with self._cond:
+            key = _pod_key(pod)
+            self.nominated_pods.delete(pod)
+            self.active_q.delete_by_key(key)
+            self.pod_backoff_q.delete_by_key(key)
+            self.unschedulable_q.pop(key, None)
+
+    # -- pop ----------------------------------------------------------------
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[PodInfo]:
+        """Blocking pop from activeQ (reference :372). Increments the
+        scheduling cycle; returns None on close/timeout."""
+        deadline = None if timeout is None else self._now() + timeout
+        with self._cond:
+            while len(self.active_q) == 0:
+                if self._closed:
+                    return None
+                if deadline is None:
+                    self._cond.wait()
+                else:
+                    wait = deadline - self._now()
+                    if wait <= 0.0:
+                        return None
+                    self._cond.wait(wait)
+                    if self._now() >= deadline and len(self.active_q) == 0:
+                        return None
+            pi: PodInfo = self.active_q.pop()
+            pi.attempts += 1
+            self.scheduling_cycle += 1
+            return pi
+
+    def pop_batch(
+        self, max_size: int, timeout: Optional[float] = None
+    ) -> List[PodInfo]:
+        """TPU batch drain: block for the first pod, then take up to
+        ``max_size`` without blocking. One scheduling cycle per batch."""
+        first = self.pop(timeout=timeout)
+        if first is None:
+            return []
+        batch = [first]
+        with self._cond:
+            while len(batch) < max_size and len(self.active_q) > 0:
+                pi: PodInfo = self.active_q.pop()
+                pi.attempts += 1
+                batch.append(pi)
+        return batch
+
+    # -- move machinery -----------------------------------------------------
+
+    def move_all_to_active_or_backoff_queue(self, event: str) -> None:
+        """Reference :494: wake everything in unschedulableQ."""
+        with self._cond:
+            for key, pi in list(self.unschedulable_q.items()):
+                if self._is_backing_off(pi):
+                    self.pod_backoff_q.add(pi)
+                else:
+                    self.active_q.add(pi)
+                del self.unschedulable_q[key]
+            self.move_request_cycle = self.scheduling_cycle
+            self._cond.notify_all()
+
+    def move_pods_to_active_or_backoff_queue(
+        self, pod_infos: List[PodInfo], event: str
+    ) -> None:
+        """Reference :527 movePodsToActiveOrBackoffQueue (targeted wake,
+        e.g. pods with matching affinity terms on AssignedPodAdd)."""
+        with self._cond:
+            for pi in pod_infos:
+                key = _info_key(pi)
+                if key not in self.unschedulable_q:
+                    continue
+                if self._is_backing_off(pi):
+                    self.pod_backoff_q.add(pi)
+                else:
+                    self.active_q.add(pi)
+                del self.unschedulable_q[key]
+            self.move_request_cycle = self.scheduling_cycle
+            self._cond.notify_all()
+
+    def unschedulable_pods(self) -> List[PodInfo]:
+        with self._lock:
+            return list(self.unschedulable_q.values())
+
+    # -- flush loops (reference :234-237 run goroutines) --------------------
+
+    def flush_backoff_q_completed(self) -> None:
+        """Move pods whose backoff expired from backoffQ to activeQ
+        (run every 1s by the reference)."""
+        with self._cond:
+            moved = False
+            while len(self.pod_backoff_q) > 0:
+                pi = self.pod_backoff_q.peek()
+                if self._backoff_time(pi) > self._now():
+                    break
+                self.active_q.add(self.pod_backoff_q.pop())
+                moved = True
+            if moved:
+                self._cond.notify_all()
+
+    def flush_unschedulable_q_leftover(self) -> None:
+        """Pods stuck in unschedulableQ longer than 60s move back
+        (run every 30s by the reference)."""
+        now = self._now()
+        with self._cond:
+            to_move = [
+                pi
+                for pi in self.unschedulable_q.values()
+                if now - pi.timestamp > UNSCHEDULABLE_Q_TIME_INTERVAL
+            ]
+        if to_move:
+            self.move_pods_to_active_or_backoff_queue(
+                to_move, events.UnschedulableTimeout
+            )
+
+    def run(self) -> List[threading.Thread]:
+        """Start the two flush loops as daemon threads."""
+        stop = threading.Event()
+        self._stop_flush = stop
+
+        def loop(fn, interval):
+            while not stop.is_set():
+                stop.wait(interval)
+                if stop.is_set():
+                    return
+                fn()
+
+        threads = [
+            threading.Thread(
+                target=loop, args=(self.flush_backoff_q_completed, 1.0), daemon=True
+            ),
+            threading.Thread(
+                target=loop,
+                args=(self.flush_unschedulable_q_leftover, 30.0),
+                daemon=True,
+            ),
+        ]
+        for t in threads:
+            t.start()
+        return threads
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            if hasattr(self, "_stop_flush"):
+                self._stop_flush.set()
+            self._cond.notify_all()
+
+    # -- nominated pods (interface :95-:110) --------------------------------
+
+    def update_nominated_pod_for_node(self, pod: Pod, node_name: str) -> None:
+        with self._lock:
+            self.nominated_pods.add(pod, node_name)
+
+    def delete_nominated_pod_if_exists(self, pod: Pod) -> None:
+        with self._lock:
+            self.nominated_pods.delete(pod)
+
+    def nominated_pods_for_node(self, node_name: str) -> List[Pod]:
+        with self._lock:
+            return self.nominated_pods.pods_for_node(node_name)
+
+    # -- introspection ------------------------------------------------------
+
+    def pending_pods(self) -> List[Pod]:
+        with self._lock:
+            return (
+                [pi.pod for pi in self.active_q.list()]
+                + [pi.pod for pi in self.pod_backoff_q.list()]
+                + [pi.pod for pi in self.unschedulable_q.values()]
+            )
+
+    def num_pending(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "active": len(self.active_q),
+                "backoff": len(self.pod_backoff_q),
+                "unschedulable": len(self.unschedulable_q),
+            }

@@ -1,0 +1,3 @@
+from kubernetes_tpu.testing.wrappers import PodWrapper, NodeWrapper, make_node, make_pod
+
+__all__ = ["PodWrapper", "NodeWrapper", "make_node", "make_pod"]

@@ -1,0 +1,119 @@
+from kubernetes_tpu.cache.cache import SchedulerCache
+from kubernetes_tpu.cache.node_info import NodeInfo
+from kubernetes_tpu.cache.snapshot import Snapshot, new_snapshot
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _node(name="n1", cpu="4", mem="32Gi"):
+    return make_node(name).capacity(cpu=cpu, memory=mem).obj()
+
+
+def _pod(name="p1", cpu="1", mem="1Gi", node=""):
+    w = make_pod(name).container(cpu=cpu, memory=mem)
+    if node:
+        w.node(node)
+    return w.obj()
+
+
+def test_node_info_accumulation():
+    ni = NodeInfo(_node())
+    assert ni.allocatable.milli_cpu == 4000
+    p = _pod(node="n1")
+    ni.add_pod(p)
+    assert ni.requested.milli_cpu == 1000
+    assert ni.requested.memory == 1024**3
+    assert len(ni.pods) == 1
+    assert ni.remove_pod(p)
+    assert ni.requested.milli_cpu == 0
+    assert len(ni.pods) == 0
+
+
+def test_node_info_nonzero_defaults():
+    ni = NodeInfo(_node())
+    p = make_pod("empty").container(cpu="0", memory="0").node("n1").obj()
+    ni.add_pod(p)
+    assert ni.requested.milli_cpu == 0
+    assert ni.non_zero_requested.milli_cpu == 100
+    assert ni.non_zero_requested.memory == 200 * 1024 * 1024
+
+
+def test_host_ports():
+    ni = NodeInfo(_node())
+    p = make_pod("hp").container(cpu="1", memory="1Gi", host_port=8080).node("n1").obj()
+    ni.add_pod(p)
+    assert ni.used_ports.conflicts("0.0.0.0", "TCP", 8080)
+    assert not ni.used_ports.conflicts("0.0.0.0", "TCP", 8081)
+    assert not ni.used_ports.conflicts("0.0.0.0", "UDP", 8080)
+
+
+def test_cache_assume_add_expire():
+    now = [0.0]
+    cache = SchedulerCache(ttl_seconds=30.0, now=lambda: now[0])
+    cache.add_node(_node("n1"))
+    p = _pod("p1", node="n1")
+
+    cache.assume_pod(p)
+    assert cache.is_assumed_pod(p)
+    assert cache.pod_count() == 1
+    cache.finish_binding(p)
+
+    # before TTL: still there
+    now[0] = 10.0
+    assert cache.cleanup_expired_assumed_pods() == []
+    # after TTL: expired
+    now[0] = 31.0
+    expired = cache.cleanup_expired_assumed_pods()
+    assert [e.key() for e in expired] == ["default/p1"]
+    assert cache.pod_count() == 0
+
+
+def test_cache_assume_then_confirm():
+    cache = SchedulerCache()
+    cache.add_node(_node("n1"))
+    p = _pod("p1", node="n1")
+    cache.assume_pod(p)
+    cache.finish_binding(p)
+    cache.add_pod(p)  # informer confirms
+    assert not cache.is_assumed_pod(p)
+    assert cache.cleanup_expired_assumed_pods() == []
+    assert cache.pod_count() == 1
+
+
+def test_incremental_snapshot_copies_only_changed():
+    cache = SchedulerCache()
+    cache.add_node(_node("n1"))
+    cache.add_node(_node("n2"))
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    assert snap.num_nodes() == 2
+    ni1_before = snap.get_node_info("n1")
+    ni2_before = snap.get_node_info("n2")
+
+    cache.add_pod(_pod("p1", node="n2"))
+    cache.update_snapshot(snap)
+    # n1 untouched => same object; n2 changed => recloned
+    assert snap.get_node_info("n1") is ni1_before
+    assert snap.get_node_info("n2") is not ni2_before
+    assert snap.get_node_info("n2").requested.milli_cpu == 1000
+
+
+def test_snapshot_node_removal():
+    cache = SchedulerCache()
+    n1, n2 = _node("n1"), _node("n2")
+    cache.add_node(n1)
+    cache.add_node(n2)
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    cache.remove_node(n2)
+    cache.update_snapshot(snap)
+    assert snap.num_nodes() == 1
+    assert snap.get_node_info("n2") is None
+
+
+def test_new_snapshot_helper():
+    nodes = [_node("n1"), _node("n2")]
+    pods = [_pod("p1", node="n1"), _pod("p2", node="missing")]
+    snap = new_snapshot(pods, nodes)
+    assert snap.num_nodes() == 2
+    assert len(snap.get_node_info("n1").pods) == 1
+    assert snap.list_pods()[0].name == "p1"

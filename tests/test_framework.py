@@ -1,0 +1,161 @@
+"""Framework runtime tests with inline fake plugins
+(reference framework/v1alpha1/framework_test.go pattern)."""
+
+import pytest
+
+from kubernetes_tpu.cache.node_info import NodeInfo
+from kubernetes_tpu.config.types import Plugin as PluginRef, Plugins, PluginSet
+from kubernetes_tpu.framework.interface import (
+    CycleState,
+    Plugin,
+    Status,
+    StatusCode,
+)
+from kubernetes_tpu.framework.registry import Registry
+from kubernetes_tpu.framework.runtime import Framework
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+class FakeFilterPlugin(Plugin):
+    NAME = "FakeFilter"
+
+    def __init__(self, fail_nodes=()):
+        self.fail_nodes = set(fail_nodes)
+        self.calls = 0
+
+    def filter(self, state, pod, node_info):
+        self.calls += 1
+        if node_info.node_name in self.fail_nodes:
+            return Status.unschedulable("blocked")
+        return None
+
+
+class FakeScorePlugin(Plugin):
+    NAME = "FakeScore"
+
+    def __init__(self, scores=None):
+        self.scores = scores or {}
+
+    def score(self, state, pod, node_name):
+        return self.scores.get(node_name, 0), None
+
+    def normalize_score(self, state, pod, scores):
+        max_s = max((ns.score for ns in scores), default=0) or 1
+        for ns in scores:
+            ns.score = ns.score * 100 // max_s
+        return None
+
+
+class FakePermitWait(Plugin):
+    NAME = "FakePermitWait"
+
+    def permit(self, state, pod, node_name):
+        return Status.wait(), 0.2
+
+
+def _framework(plugins_cfg, registry_entries):
+    registry = Registry()
+    for name, factory in registry_entries.items():
+        registry.register(name, factory)
+    return Framework(registry, plugins_cfg)
+
+
+def test_filter_pipeline():
+    fp = FakeFilterPlugin(fail_nodes={"bad"})
+    plugins = Plugins(filter=PluginSet(enabled=[PluginRef("FakeFilter")]))
+    fw = _framework(plugins, {"FakeFilter": lambda args, h: fp})
+    pod = make_pod("p").obj()
+    good = NodeInfo(make_node("good").capacity(cpu="1", memory="1Gi").obj())
+    bad = NodeInfo(make_node("bad").capacity(cpu="1", memory="1Gi").obj())
+    assert fw.run_filter_plugins(CycleState(), pod, good) == {}
+    statuses = fw.run_filter_plugins(CycleState(), pod, bad)
+    assert statuses["FakeFilter"].code == StatusCode.UNSCHEDULABLE
+
+
+def test_score_normalize_and_weight():
+    sp = FakeScorePlugin(scores={"n1": 10, "n2": 20})
+    plugins = Plugins(score=PluginSet(enabled=[PluginRef("FakeScore", weight=2)]))
+    fw = _framework(plugins, {"FakeScore": lambda args, h: sp})
+    scores, status = fw.run_score_plugins(CycleState(), make_pod("p").obj(), ["n1", "n2"])
+    assert status is None
+    by_name = {ns.name: ns.score for ns in scores["FakeScore"]}
+    # normalized to [50, 100] then x2 weight
+    assert by_name == {"n1": 100, "n2": 200}
+
+
+def test_score_out_of_range_rejected():
+    class BadScore(Plugin):
+        NAME = "Bad"
+
+        def score(self, state, pod, node_name):
+            return 1000, None
+
+    plugins = Plugins(score=PluginSet(enabled=[PluginRef("Bad")]))
+    fw = _framework(plugins, {"Bad": lambda args, h: BadScore()})
+    _, status = fw.run_score_plugins(CycleState(), make_pod("p").obj(), ["n1"])
+    assert status is not None and status.code == StatusCode.ERROR
+
+
+def test_permit_wait_then_allow():
+    import threading
+
+    plugins = Plugins(permit=PluginSet(enabled=[PluginRef("FakePermitWait")]))
+    fw = _framework(plugins, {"FakePermitWait": lambda a, h: FakePermitWait()})
+    pod = make_pod("p").obj()
+    status = fw.run_permit_plugins(CycleState(), pod, "n1")
+    assert status.code == StatusCode.WAIT
+    wp = fw.get_waiting_pod(pod.metadata.uid)
+    assert wp is not None
+
+    threading.Timer(0.02, lambda: wp.allow("FakePermitWait")).start()
+    assert fw.wait_on_permit(pod) is None
+
+
+def test_permit_wait_timeout_rejects():
+    plugins = Plugins(permit=PluginSet(enabled=[PluginRef("FakePermitWait")]))
+    fw = _framework(plugins, {"FakePermitWait": lambda a, h: FakePermitWait()})
+    pod = make_pod("p").obj()
+    fw.run_permit_plugins(CycleState(), pod, "n1")
+    status = fw.wait_on_permit(pod)
+    assert status is not None and status.code == StatusCode.UNSCHEDULABLE
+
+
+def test_unknown_plugin_rejected():
+    plugins = Plugins(filter=PluginSet(enabled=[PluginRef("Nope")]))
+    with pytest.raises(ValueError, match="not registered"):
+        _framework(plugins, {})
+
+
+def test_plugin_missing_extension_point_rejected():
+    plugins = Plugins(score=PluginSet(enabled=[PluginRef("FakeFilter")]))
+    with pytest.raises(ValueError, match="does not implement"):
+        _framework(plugins, {"FakeFilter": lambda a, h: FakeFilterPlugin()})
+
+
+def test_cycle_state_clone():
+    class St:
+        def __init__(self, v):
+            self.v = v
+
+        def clone(self):
+            return St(self.v)
+
+    cs = CycleState()
+    cs.write("k", St(1))
+    c2 = cs.clone()
+    assert c2.read("k").v == 1
+    assert c2.read("k") is not cs.read("k")
+    with pytest.raises(KeyError):
+        cs.read("missing")
+
+
+def test_plugins_apply_merge():
+    defaults = Plugins(filter=PluginSet(enabled=[PluginRef("A"), PluginRef("B")]))
+    custom = Plugins(
+        filter=PluginSet(enabled=[PluginRef("C")], disabled=[PluginRef("A")])
+    )
+    merged = defaults.apply(custom)
+    assert [p.name for p in merged.filter.enabled] == ["B", "C"]
+    star = Plugins(filter=PluginSet(disabled=[PluginRef("*")]))
+    merged = defaults.apply(star)
+    assert merged.filter.enabled == []

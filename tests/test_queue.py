@@ -1,0 +1,131 @@
+from kubernetes_tpu.framework.interface import PodInfo
+from kubernetes_tpu.queue import events
+from kubernetes_tpu.queue.heap import Heap
+from kubernetes_tpu.queue.scheduling_queue import PriorityQueue
+from kubernetes_tpu.testing import make_pod
+
+
+def priority_less(a: PodInfo, b: PodInfo) -> bool:
+    """PrioritySort semantics: higher priority first, then earlier queue time."""
+    pa, pb = a.pod.spec.priority, b.pod.spec.priority
+    if pa != pb:
+        return pa > pb
+    return a.timestamp < b.timestamp
+
+
+def _pq(now):
+    return PriorityQueue(priority_less, now=lambda: now[0])
+
+
+def test_heap_basic():
+    h = Heap(lambda x: x[0], lambda a, b: a[1] < b[1])
+    h.add(("a", 3))
+    h.add(("b", 1))
+    h.add(("c", 2))
+    assert h.pop() == ("b", 1)
+    h.add(("c", 0))  # update key c
+    assert h.pop() == ("c", 0)
+    assert h.pop() == ("a", 3)
+    assert len(h) == 0
+
+
+def test_pop_orders_by_priority():
+    now = [0.0]
+    q = _pq(now)
+    q.add(make_pod("low").priority(1).obj())
+    q.add(make_pod("high").priority(10).obj())
+    q.add(make_pod("mid").priority(5).obj())
+    assert q.pop().pod.name == "high"
+    assert q.pop().pod.name == "mid"
+    assert q.pop().pod.name == "low"
+
+
+def test_unschedulable_then_move_on_event():
+    now = [0.0]
+    q = _pq(now)
+    q.add(make_pod("p1").obj())
+    pi = q.pop()
+    cycle = q.scheduling_cycle
+    q.add_unschedulable_if_not_present(pi, cycle)
+    assert q.num_pending()["unschedulable"] == 1
+
+    # node-add event moves it; backoff (1s) still pending at t=0 -> backoffQ
+    q.move_all_to_active_or_backoff_queue(events.NodeAdd)
+    assert q.num_pending()["backoff"] == 1
+    # after backoff expires, flush moves it to activeQ
+    now[0] = 3.0
+    q.flush_backoff_q_completed()
+    assert q.num_pending()["active"] == 1
+    assert q.pop().pod.name == "p1"
+
+
+def test_move_request_cycle_prevents_lost_wakeup():
+    """A move request during a pod's scheduling attempt must send the
+    failed pod to backoffQ, not unschedulableQ (scheduling_queue.go:141)."""
+    now = [0.0]
+    q = _pq(now)
+    q.add(make_pod("p1").obj())
+    pi = q.pop()
+    cycle = q.scheduling_cycle
+    # concurrent event while p1 was being scheduled:
+    q.move_all_to_active_or_backoff_queue(events.NodeAdd)
+    q.add_unschedulable_if_not_present(pi, cycle)
+    assert q.num_pending()["unschedulable"] == 0
+    assert q.num_pending()["backoff"] == 1
+
+
+def test_backoff_grows_exponentially():
+    now = [0.0]
+    q = _pq(now)
+    q.add(make_pod("p1").obj())
+    pi = q.pop()
+    assert pi.attempts == 1
+    assert q._backoff_duration(pi) == 1.0  # first failure: initial backoff
+    pi.attempts = 3
+    assert q._backoff_duration(pi) == 4.0  # 1s * 2^(attempts-1)
+    pi.attempts = 10
+    assert q._backoff_duration(pi) == 10.0  # capped at max
+
+
+def test_flush_unschedulable_leftover():
+    now = [0.0]
+    q = _pq(now)
+    q.add(make_pod("p1").obj())
+    pi = q.pop()
+    q.add_unschedulable_if_not_present(pi, q.scheduling_cycle)
+    now[0] = 61.0
+    q.flush_unschedulable_q_leftover()
+    assert q.num_pending()["unschedulable"] == 0
+    assert q.num_pending()["active"] == 1  # backoff long expired
+
+
+def test_pop_batch_drains():
+    now = [0.0]
+    q = _pq(now)
+    for i in range(5):
+        q.add(make_pod(f"p{i}").priority(i).obj())
+    batch = q.pop_batch(3)
+    assert [pi.pod.name for pi in batch] == ["p4", "p3", "p2"]
+    assert q.num_pending()["active"] == 2
+
+
+def test_nominated_pods():
+    now = [0.0]
+    q = _pq(now)
+    p = make_pod("p1").obj()
+    q.update_nominated_pod_for_node(p, "n1")
+    assert [x.name for x in q.nominated_pods_for_node("n1")] == ["p1"]
+    q.delete_nominated_pod_if_exists(p)
+    assert q.nominated_pods_for_node("n1") == []
+
+
+def test_update_in_unschedulable_moves_to_active():
+    now = [0.0]
+    q = _pq(now)
+    q.add(make_pod("p1").obj())
+    pi = q.pop()
+    q.add_unschedulable_if_not_present(pi, q.scheduling_cycle)
+    now[0] = 5.0  # backoff expired
+    updated = make_pod("p1").labels(v="2").obj()
+    q.update(pi.pod, updated)
+    assert q.num_pending()["active"] == 1

@@ -1,0 +1,41 @@
+from kubernetes_tpu.api.resource import (
+    format_cpu,
+    format_memory,
+    parse_cpu,
+    parse_memory,
+    parse_quantity,
+)
+
+
+def test_parse_cpu():
+    assert parse_cpu("1") == 1000
+    assert parse_cpu("100m") == 100
+    assert parse_cpu("2500m") == 2500
+    assert parse_cpu(0.5) == 500
+    assert parse_cpu("0.1") == 100
+    assert parse_cpu(4) == 4000
+
+
+def test_parse_memory():
+    assert parse_memory("128Mi") == 128 * 1024 * 1024
+    assert parse_memory("1Gi") == 1024**3
+    assert parse_memory("1G") == 10**9
+    assert parse_memory("500") == 500
+    assert parse_memory("1Ki") == 1024
+    assert parse_memory("2Ti") == 2 * 1024**4
+
+
+def test_parse_quantity_suffixes():
+    assert parse_quantity("1k") == 1000
+    assert parse_quantity("1M") == 1e6
+    assert parse_quantity("10") == 10
+    assert parse_quantity("1.5") == 1.5
+    # scientific notation
+    assert parse_quantity("1e3") == 1000
+
+
+def test_format_roundtrip():
+    assert format_cpu(1000) == "1"
+    assert format_cpu(250) == "250m"
+    assert format_memory(1024**3) == "1Gi"
+    assert format_memory(123) == "123"

@@ -1,0 +1,99 @@
+from kubernetes_tpu.api.selectors import (
+    labels_match_selector,
+    match_node_selector_term,
+    node_matches_node_selector,
+)
+from kubernetes_tpu.api.types import (
+    LabelSelector,
+    LabelSelectorRequirement,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Taint,
+    Toleration,
+)
+
+
+def test_nil_selector_matches_nothing():
+    assert not labels_match_selector({"a": "b"}, None)
+
+
+def test_empty_selector_matches_everything():
+    assert labels_match_selector({"a": "b"}, LabelSelector())
+    assert labels_match_selector({}, LabelSelector())
+
+
+def test_match_labels():
+    sel = LabelSelector(match_labels={"app": "web"})
+    assert labels_match_selector({"app": "web", "x": "y"}, sel)
+    assert not labels_match_selector({"app": "db"}, sel)
+
+
+def test_match_expressions():
+    sel = LabelSelector(
+        match_expressions=[
+            LabelSelectorRequirement(key="tier", operator="In", values=["a", "b"]),
+            LabelSelectorRequirement(key="gone", operator="DoesNotExist"),
+        ]
+    )
+    assert labels_match_selector({"tier": "a"}, sel)
+    assert not labels_match_selector({"tier": "c"}, sel)
+    assert not labels_match_selector({"tier": "a", "gone": "1"}, sel)
+
+
+def test_node_selector_terms_or():
+    sel = NodeSelector(
+        node_selector_terms=[
+            NodeSelectorTerm(
+                match_expressions=[
+                    NodeSelectorRequirement(key="zone", operator="In", values=["z1"])
+                ]
+            ),
+            NodeSelectorTerm(
+                match_expressions=[
+                    NodeSelectorRequirement(key="zone", operator="In", values=["z2"])
+                ]
+            ),
+        ]
+    )
+    assert node_matches_node_selector({"zone": "z2"}, sel)
+    assert not node_matches_node_selector({"zone": "z3"}, sel)
+
+
+def test_empty_term_matches_nothing():
+    assert not match_node_selector_term({"a": "b"}, NodeSelectorTerm())
+
+
+def test_gt_lt_operators():
+    term = NodeSelectorTerm(
+        match_expressions=[
+            NodeSelectorRequirement(key="cores", operator="Gt", values=["8"])
+        ]
+    )
+    assert match_node_selector_term({"cores": "16"}, term)
+    assert not match_node_selector_term({"cores": "4"}, term)
+    assert not match_node_selector_term({"cores": "abc"}, term)
+    assert not match_node_selector_term({}, term)
+
+
+def test_match_fields():
+    term = NodeSelectorTerm(
+        match_fields=[
+            NodeSelectorRequirement(
+                key="metadata.name", operator="In", values=["node-1"]
+            )
+        ]
+    )
+    assert match_node_selector_term({}, term, node_fields={"metadata.name": "node-1"})
+    assert not match_node_selector_term({}, term, node_fields={"metadata.name": "x"})
+
+
+def test_toleration_matching():
+    taint = Taint(key="gpu", value="true", effect="NoSchedule")
+    assert Toleration(key="gpu", operator="Equal", value="true").tolerates(taint)
+    assert Toleration(key="gpu", operator="Exists").tolerates(taint)
+    assert Toleration(key="", operator="Exists").tolerates(taint)  # match-all
+    assert not Toleration(key="gpu", operator="Equal", value="false").tolerates(taint)
+    assert not Toleration(
+        key="gpu", operator="Exists", effect="NoExecute"
+    ).tolerates(taint)
