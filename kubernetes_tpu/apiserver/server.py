@@ -1,0 +1,259 @@
+"""The in-process API server.
+
+Semantics modeled on the reference storage layer:
+
+- monotonically increasing resourceVersion per write
+  (etcd3/store.go: ModRevision)
+- create is txn-if-absent (store.go:144); update uses optimistic
+  concurrency on resourceVersion (store.go:220 GuaranteedUpdate)
+- watch(since_rv) replays buffered events after rv, then streams live
+  (storage/cacher/cacher.go:238 watchCache fan-out)
+- the pods/binding subresource sets spec.nodeName under a guaranteed
+  update and refuses to re-bind a bound pod
+  (pkg/registry/core/pod/storage/storage.go:159-229 assignPod)
+
+Objects returned by get/list and carried in watch events are shared
+references: callers must treat them as read-only and deep-copy before
+mutating (the same contract client-go informer caches impose).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api.types import Binding, Node, Pod
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+class NotFound(KeyError):
+    pass
+
+
+class Conflict(ValueError):
+    pass
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    object: Any
+    resource_version: int
+
+
+class Watch:
+    """One client watch stream; events arrive on an internal queue."""
+
+    def __init__(self, server: "APIServer", kind: str):
+        self._server = server
+        self.kind = kind
+        self._q: "_queue.Queue[Optional[WatchEvent]]" = _queue.Queue()
+        self.stopped = False
+
+    def _deliver(self, event: WatchEvent) -> None:
+        self._q.put(event)
+
+    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        """Next event, or None on stop/timeout."""
+        try:
+            ev = self._q.get(timeout=timeout)
+        except _queue.Empty:
+            return None
+        return ev
+
+    def pending(self) -> List[WatchEvent]:
+        """Drain without blocking (used by the synchronous pump mode)."""
+        out = []
+        while True:
+            try:
+                ev = self._q.get_nowait()
+            except _queue.Empty:
+                return out
+            if ev is not None:
+                out.append(ev)
+
+    def stop(self) -> None:
+        self.stopped = True
+        self._server._remove_watch(self)
+        self._q.put(None)
+
+
+def _obj_key(obj: Any) -> Tuple[str, str]:
+    meta = obj.metadata
+    return (meta.namespace, meta.name)
+
+
+class APIServer:
+    """Multi-kind object store with watch fan-out."""
+
+    #: kinds with namespaced storage
+    KINDS = ("Pod", "Node", "PodDisruptionBudget", "PodGroup", "Lease", "Service")
+
+    def __init__(self, watch_history_limit: int = 200_000) -> None:
+        self._lock = threading.RLock()
+        self._rv = 0
+        self._stores: Dict[str, Dict[Tuple[str, str], Any]] = {
+            k: {} for k in self.KINDS
+        }
+        self._watches: Dict[str, List[Watch]] = {k: [] for k in self.KINDS}
+        # bounded per-kind event history for watch(since_rv) replay
+        self._history: Dict[str, List[WatchEvent]] = {k: [] for k in self.KINDS}
+        self._history_limit = watch_history_limit
+
+    # -- core ---------------------------------------------------------------
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def _broadcast(self, kind: str, event: WatchEvent) -> None:
+        hist = self._history[kind]
+        hist.append(event)
+        if len(hist) > self._history_limit:
+            del hist[: len(hist) // 2]
+        for w in list(self._watches[kind]):
+            w._deliver(event)
+
+    def current_rv(self) -> int:
+        with self._lock:
+            return self._rv
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def create(self, obj: Any) -> Any:
+        kind = obj.kind
+        with self._lock:
+            store = self._stores[kind]
+            key = _obj_key(obj)
+            if key in store:
+                raise Conflict(f"{kind} {key} already exists")
+            obj.metadata.resource_version = self._next_rv()
+            store[key] = obj
+            self._broadcast(kind, WatchEvent(ADDED, obj, obj.metadata.resource_version))
+            return obj
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        with self._lock:
+            obj = self._stores[kind].get((namespace, name))
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return obj
+
+    def list(self, kind: str) -> Tuple[List[Any], int]:
+        """Returns (objects, resourceVersion) -- the list+watch handshake."""
+        with self._lock:
+            return list(self._stores[kind].values()), self._rv
+
+    def update(self, obj: Any, expect_rv: Optional[int] = None) -> Any:
+        """Replace; optimistic-concurrency check when expect_rv given."""
+        kind = obj.kind
+        with self._lock:
+            store = self._stores[kind]
+            key = _obj_key(obj)
+            current = store.get(key)
+            if current is None:
+                raise NotFound(f"{kind} {key} not found")
+            if expect_rv is not None and current.metadata.resource_version != expect_rv:
+                raise Conflict(
+                    f"{kind} {key}: resourceVersion {expect_rv} is stale "
+                    f"(current {current.metadata.resource_version})"
+                )
+            obj.metadata.resource_version = self._next_rv()
+            store[key] = obj
+            self._broadcast(
+                kind, WatchEvent(MODIFIED, obj, obj.metadata.resource_version)
+            )
+            return obj
+
+    def guaranteed_update(
+        self, kind: str, namespace: str, name: str, mutate: Callable[[Any], None]
+    ) -> Any:
+        """Atomic read-modify-write (etcd3 store.go:220 GuaranteedUpdate).
+
+        Copy-on-write: the previously stored object stays intact so informer
+        caches can hand handlers a distinct (old, new) pair -- the reference
+        gets this for free from serialization; mutators must not mutate
+        nested collections in place.
+        """
+        import copy as _copy
+
+        with self._lock:
+            old = self.get(kind, namespace, name)
+            obj = _copy.copy(old)
+            obj.metadata = _copy.copy(old.metadata)
+            for attr in ("spec", "status"):
+                if hasattr(old, attr):
+                    setattr(obj, attr, _copy.copy(getattr(old, attr)))
+            mutate(obj)
+            obj.metadata.resource_version = self._next_rv()
+            self._stores[kind][(namespace, name)] = obj
+            self._broadcast(
+                kind, WatchEvent(MODIFIED, obj, obj.metadata.resource_version)
+            )
+            return obj
+
+    def delete(self, kind: str, namespace: str, name: str) -> Any:
+        with self._lock:
+            obj = self._stores[kind].pop((namespace, name), None)
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            rv = self._next_rv()
+            self._broadcast(kind, WatchEvent(DELETED, obj, rv))
+            return obj
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(self, kind: str, since_rv: int = 0) -> Watch:
+        with self._lock:
+            w = Watch(self, kind)
+            for ev in self._history[kind]:
+                if ev.resource_version > since_rv:
+                    w._deliver(ev)
+            self._watches[kind].append(w)
+            return w
+
+    def _remove_watch(self, w: Watch) -> None:
+        with self._lock:
+            try:
+                self._watches[w.kind].remove(w)
+            except ValueError:
+                pass
+
+    # -- pods/binding subresource (storage.go:159 BindingREST.Create) -------
+
+    def bind(self, binding: Binding) -> Pod:
+        with self._lock:
+            pod: Pod = self.get("Pod", binding.pod_namespace, binding.pod_name)
+            if binding.pod_uid and pod.metadata.uid != binding.pod_uid:
+                raise Conflict(
+                    f"pod {pod.key()} uid mismatch: binding has "
+                    f"{binding.pod_uid}, pod has {pod.metadata.uid}"
+                )
+            if pod.spec.node_name and pod.spec.node_name != binding.target_node:
+                raise Conflict(
+                    f"pod {pod.key()} is already bound to {pod.spec.node_name}"
+                )
+            if not binding.target_node:
+                raise ValueError("binding.target_node is required")
+
+            def assign(p: Pod) -> None:
+                p.spec.node_name = binding.target_node
+
+            return self.guaranteed_update(
+                "Pod", binding.pod_namespace, binding.pod_name, assign
+            )
+
+    # -- pod status subresource ---------------------------------------------
+
+    def update_pod_status(
+        self, namespace: str, name: str, mutate: Callable[[Pod], None]
+    ) -> Pod:
+        def wrap(p: Pod) -> None:
+            mutate(p)
+
+        return self.guaranteed_update("Pod", namespace, name, wrap)

@@ -48,6 +48,20 @@ class Snapshot:
         self.have_pods_with_affinity_list = [
             ni for ni in self.node_info_list if ni.pods_with_affinity
         ]
+        self._image_num_nodes = None
+
+    def image_num_nodes(self) -> Dict[str, int]:
+        """image name -> number of nodes holding it; computed once per
+        snapshot refresh (reference ImageStateSummary.NumNodes,
+        snapshot.go:124 createImageStates)."""
+        cached = getattr(self, "_image_num_nodes", None)
+        if cached is None:
+            cached = {}
+            for ni in self.node_info_list:
+                for image in ni.image_states:
+                    cached[image] = cached.get(image, 0) + 1
+            self._image_num_nodes = cached
+        return cached
 
 
 def new_snapshot(pods: Iterable[Pod], nodes: Iterable[Node]) -> Snapshot:

@@ -1,0 +1,78 @@
+"""Typed client over the in-process API server (clientset equivalent)."""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from kubernetes_tpu.api.types import (
+    Binding,
+    Node,
+    Pod,
+    PodDisruptionBudget,
+    PodGroup,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+
+
+class Client:
+    def __init__(self, server: APIServer):
+        self._server = server
+
+    # pods
+    def create_pod(self, pod: Pod) -> Pod:
+        return self._server.create(pod)
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        return self._server.get("Pod", namespace, name)
+
+    def list_pods(self) -> Tuple[List[Pod], int]:
+        return self._server.list("Pod")
+
+    def update_pod(self, pod: Pod, expect_rv: Optional[int] = None) -> Pod:
+        return self._server.update(pod, expect_rv)
+
+    def delete_pod(self, namespace: str, name: str) -> Pod:
+        return self._server.delete("Pod", namespace, name)
+
+    def bind(self, binding: Binding) -> Pod:
+        """POST pods/<name>/binding (reference default_binder.go:50)."""
+        return self._server.bind(binding)
+
+    def update_pod_status(
+        self, namespace: str, name: str, mutate: Callable[[Pod], None]
+    ) -> Pod:
+        return self._server.update_pod_status(namespace, name, mutate)
+
+    # nodes
+    def create_node(self, node: Node) -> Node:
+        return self._server.create(node)
+
+    def get_node(self, name: str) -> Node:
+        return self._server.get("Node", "", name)
+
+    def list_nodes(self) -> Tuple[List[Node], int]:
+        return self._server.list("Node")
+
+    def update_node(self, node: Node, expect_rv: Optional[int] = None) -> Node:
+        return self._server.update(node, expect_rv)
+
+    def delete_node(self, name: str) -> Node:
+        return self._server.delete("Node", "", name)
+
+    # policy / scheduling CRDs
+    def create_pdb(self, pdb: PodDisruptionBudget) -> PodDisruptionBudget:
+        return self._server.create(pdb)
+
+    def list_pdbs(self) -> Tuple[List[PodDisruptionBudget], int]:
+        return self._server.list("PodDisruptionBudget")
+
+    def create_pod_group(self, pg: PodGroup) -> PodGroup:
+        return self._server.create(pg)
+
+    def list_pod_groups(self) -> Tuple[List[PodGroup], int]:
+        return self._server.list("PodGroup")
+
+    # raw access (leases for leader election, etc.)
+    @property
+    def server(self) -> APIServer:
+        return self._server

@@ -1,0 +1,207 @@
+"""Informer machinery: list+watch replication into a local indexed cache.
+
+Reference: client-go Reflector (tools/cache/reflector.go:49,
+ListAndWatch :207) + SharedIndexInformer (tools/cache/shared_informer.go).
+
+Two drive modes:
+- ``start()``: a daemon thread pumps watch events continuously (the
+  production shape).
+- ``pump()``: synchronously drain pending events on the caller's thread --
+  deterministic for tests and for the batched bench loop, where the solver
+  wants snapshot updates at batch boundaries anyway.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.apiserver.server import (
+    ADDED,
+    APIServer,
+    DELETED,
+    MODIFIED,
+    Watch,
+    WatchEvent,
+)
+
+
+class ResourceEventHandler:
+    """Reference cache.ResourceEventHandlerFuncs."""
+
+    def __init__(
+        self,
+        on_add: Optional[Callable[[Any], None]] = None,
+        on_update: Optional[Callable[[Any, Any], None]] = None,
+        on_delete: Optional[Callable[[Any], None]] = None,
+        filter_func: Optional[Callable[[Any], bool]] = None,
+    ):
+        self.on_add = on_add
+        self.on_update = on_update
+        self.on_delete = on_delete
+        self.filter_func = filter_func
+
+    def _passes(self, obj: Any) -> bool:
+        return self.filter_func is None or self.filter_func(obj)
+
+    def handle(self, event_type: str, old: Any, new: Any) -> None:
+        """FilteringResourceEventHandler semantics
+        (shared_informer.go): filter transitions produce add/delete."""
+        if event_type == ADDED:
+            if self._passes(new) and self.on_add:
+                self.on_add(new)
+        elif event_type == MODIFIED:
+            old_ok = old is not None and self._passes(old)
+            new_ok = self._passes(new)
+            if old_ok and new_ok:
+                if self.on_update:
+                    self.on_update(old, new)
+            elif not old_ok and new_ok:
+                if self.on_add:
+                    self.on_add(new)
+            elif old_ok and not new_ok:
+                if self.on_delete:
+                    self.on_delete(old)
+        elif event_type == DELETED:
+            if self._passes(new) and self.on_delete:
+                self.on_delete(new)
+
+
+class Informer:
+    def __init__(self, server: APIServer, kind: str):
+        self._server = server
+        self.kind = kind
+        self._handlers: List[ResourceEventHandler] = []
+        self._store: Dict[Tuple[str, str], Any] = {}
+        self._lock = threading.RLock()
+        self._watch: Optional[Watch] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.synced = False
+
+    def add_event_handler(self, handler: ResourceEventHandler) -> None:
+        self._handlers.append(handler)
+
+    # -- lister surface -----------------------------------------------------
+
+    def list(self) -> List[Any]:
+        with self._lock:
+            return list(self._store.values())
+
+    def get(self, namespace: str, name: str) -> Optional[Any]:
+        with self._lock:
+            return self._store.get((namespace, name))
+
+    def has_synced(self) -> bool:
+        return self.synced
+
+    # -- replication --------------------------------------------------------
+
+    def _list_and_start_watch(self) -> None:
+        objs, rv = self._server.list(self.kind)
+        self._watch = self._server.watch(self.kind, since_rv=rv)
+        with self._lock:
+            for obj in objs:
+                self._store[(obj.metadata.namespace, obj.metadata.name)] = obj
+        for obj in objs:
+            for h in self._handlers:
+                h.handle(ADDED, None, obj)
+        self.synced = True
+
+    def _apply(self, ev: WatchEvent) -> None:
+        obj = ev.object
+        key = (obj.metadata.namespace, obj.metadata.name)
+        if ev.type == ADDED:
+            with self._lock:
+                self._store[key] = obj
+            for h in self._handlers:
+                h.handle(ADDED, None, obj)
+        elif ev.type == MODIFIED:
+            with self._lock:
+                old = self._store.get(key)
+                self._store[key] = obj
+            for h in self._handlers:
+                h.handle(MODIFIED, old, obj)
+        elif ev.type == DELETED:
+            with self._lock:
+                self._store.pop(key, None)
+            for h in self._handlers:
+                h.handle(DELETED, None, obj)
+
+    def pump(self) -> int:
+        """Synchronously process pending events; returns count."""
+        if self._watch is None:
+            self._list_and_start_watch()
+        n = 0
+        for ev in self._watch.pending():
+            self._apply(ev)
+            n += 1
+        return n
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        if self._watch is None:
+            self._list_and_start_watch()
+
+        def run() -> None:
+            while not self._stop.is_set():
+                ev = self._watch.next(timeout=0.1)
+                if ev is not None:
+                    self._apply(ev)
+
+        self._thread = threading.Thread(
+            target=run, name=f"informer-{self.kind}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch is not None:
+            self._watch.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+
+class InformerFactory:
+    """SharedInformerFactory: one informer per kind, shared."""
+
+    def __init__(self, server: APIServer):
+        self._server = server
+        self._informers: Dict[str, Informer] = {}
+
+    def informer(self, kind: str) -> Informer:
+        inf = self._informers.get(kind)
+        if inf is None:
+            inf = Informer(self._server, kind)
+            self._informers[kind] = inf
+        return inf
+
+    def pods(self) -> Informer:
+        return self.informer("Pod")
+
+    def nodes(self) -> Informer:
+        return self.informer("Node")
+
+    def pdbs(self) -> Informer:
+        return self.informer("PodDisruptionBudget")
+
+    def pod_groups(self) -> Informer:
+        return self.informer("PodGroup")
+
+    def start(self) -> None:
+        for inf in self._informers.values():
+            inf.start()
+
+    def pump(self) -> int:
+        return sum(inf.pump() for inf in self._informers.values())
+
+    def wait_for_cache_sync(self) -> None:
+        for inf in self._informers.values():
+            if not inf.synced:
+                inf.pump() if inf._thread is None else None
+
+    def stop(self) -> None:
+        for inf in self._informers.values():
+            inf.stop()

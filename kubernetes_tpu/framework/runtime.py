@@ -99,7 +99,7 @@ class Framework:
                 if point == "score":
                     if ref.weight == 0:
                         raise ValueError(f"score plugin {ref.name!r} weight 0")
-                    self._score_weights[ref.name] = ref.weight or 1
+                    self._score_weights[ref.name] = ref.weight
             self._by_point[point] = plist
         if len(self._by_point["queue_sort"]) > 1:
             raise ValueError("only one queue sort plugin can be enabled")

@@ -1,0 +1,68 @@
+"""In-tree plugins: the host-path (oracle) implementations.
+
+Reference: /root/reference/pkg/scheduler/framework/plugins/. Every plugin
+here has vectorized TPU equivalents in kubernetes_tpu.ops (feasibility-mask
+columns for Filter, score-matrix columns for Score); this sequential set is
+the correctness oracle the TPU profile is differentially tested against.
+"""
+
+from kubernetes_tpu.framework.registry import Registry
+
+
+def new_in_tree_registry() -> Registry:
+    """Reference framework/plugins/registry.go:45 NewInTreeRegistry."""
+    from kubernetes_tpu.plugins import (
+        defaultbinder,
+        imagelocality,
+        nodeaffinity,
+        nodename,
+        nodeports,
+        nodepreferavoidpods,
+        noderesources,
+        nodeunschedulable,
+        queuesort,
+        tainttoleration,
+    )
+
+    r = Registry()
+    r.register(queuesort.PrioritySort.NAME, lambda a, h: queuesort.PrioritySort())
+    r.register(noderesources.Fit.NAME, lambda a, h: noderesources.Fit(a))
+    r.register(
+        noderesources.LeastAllocated.NAME, lambda a, h: noderesources.LeastAllocated()
+    )
+    r.register(
+        noderesources.MostAllocated.NAME, lambda a, h: noderesources.MostAllocated()
+    )
+    r.register(
+        noderesources.BalancedAllocation.NAME,
+        lambda a, h: noderesources.BalancedAllocation(),
+    )
+    r.register(
+        noderesources.RequestedToCapacityRatio.NAME,
+        lambda a, h: noderesources.RequestedToCapacityRatio(a),
+    )
+    r.register(
+        noderesources.ResourceLimits.NAME, lambda a, h: noderesources.ResourceLimits()
+    )
+    r.register(nodename.NodeName.NAME, lambda a, h: nodename.NodeName())
+    r.register(nodeports.NodePorts.NAME, lambda a, h: nodeports.NodePorts())
+    r.register(
+        nodeunschedulable.NodeUnschedulable.NAME,
+        lambda a, h: nodeunschedulable.NodeUnschedulable(),
+    )
+    r.register(nodeaffinity.NodeAffinity.NAME, lambda a, h: nodeaffinity.NodeAffinity())
+    r.register(
+        tainttoleration.TaintToleration.NAME,
+        lambda a, h: tainttoleration.TaintToleration(),
+    )
+    r.register(
+        imagelocality.ImageLocality.NAME, lambda a, h: imagelocality.ImageLocality(h)
+    )
+    r.register(
+        nodepreferavoidpods.NodePreferAvoidPods.NAME,
+        lambda a, h: nodepreferavoidpods.NodePreferAvoidPods(),
+    )
+    r.register(
+        defaultbinder.DefaultBinder.NAME, lambda a, h: defaultbinder.DefaultBinder(h)
+    )
+    return r

@@ -26,7 +26,22 @@ UNSCHEDULABLE_Q_TIME_INTERVAL = 60.0  # scheduling_queue.go:62
 
 
 def _pod_key(pod: Pod) -> str:
-    return f"{pod.metadata.namespace}/{pod.metadata.name}"
+    return pod.key()
+
+
+def _is_pod_updated(old: Optional[Pod], new: Pod) -> bool:
+    """Reference scheduling_queue.go isPodUpdated: compare ignoring
+    resourceVersion and status, so the scheduler's own PodScheduled
+    condition writes don't wake parked unschedulable pods."""
+    if old is None:
+        return True
+    return not (
+        old.spec == new.spec
+        and old.metadata.labels == new.metadata.labels
+        and old.metadata.annotations == new.metadata.annotations
+        and old.metadata.deletion_timestamp == new.metadata.deletion_timestamp
+        and old.metadata.owner_references == new.metadata.owner_references
+    )
 
 
 def _info_key(pi: PodInfo) -> str:
@@ -162,7 +177,11 @@ class PriorityQueue:
             pi = self.unschedulable_q.get(key)
             if pi is not None:
                 self.nominated_pods.add(new_pod, "")
+                updated = _is_pod_updated(old_pod, new_pod)
                 pi.pod = new_pod
+                if not updated:
+                    # status-only change: stay parked (isPodUpdated guard)
+                    return
                 if self._is_backing_off(pi):
                     del self.unschedulable_q[key]
                     self.pod_backoff_q.add(pi)

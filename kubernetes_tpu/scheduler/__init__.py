@@ -1,0 +1,14 @@
+"""Scheduler: the control loop(s).
+
+Reference: /root/reference/pkg/scheduler/. Two execution profiles ship:
+
+- the sequential host path (``scheduler.Scheduler.schedule_one``), a
+  faithful port of scheduleOne semantics used as the correctness oracle;
+- the TPU batch path (``batch.BatchScheduler``), which drains the activeQ
+  in batches and solves placement as one vectorized assignment problem on
+  device (kubernetes_tpu.ops).
+"""
+
+from kubernetes_tpu.scheduler.scheduler import Scheduler, new_scheduler
+
+__all__ = ["Scheduler", "new_scheduler"]

@@ -1,0 +1,129 @@
+"""Informer event handlers bridging cluster mutations into cache + queue.
+
+Reference: /root/reference/pkg/scheduler/eventhandlers.go:350
+(addAllEventHandlers): assigned pods feed the cache, unassigned pods feed
+the queue, node/PV/PVC/Service events wake unschedulable pods with typed
+event strings.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING
+
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.client.informer import InformerFactory, ResourceEventHandler
+from kubernetes_tpu.queue import events
+
+if TYPE_CHECKING:
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+logger = logging.getLogger(__name__)
+
+
+def _assigned(pod: Pod) -> bool:
+    return bool(pod.spec.node_name)
+
+
+def _responsible_for_pod(sched: "Scheduler", pod: Pod) -> bool:
+    return pod.spec.scheduler_name in sched.profiles
+
+
+def add_all_event_handlers(
+    sched: "Scheduler", informer_factory: InformerFactory
+) -> None:
+    pods = informer_factory.pods()
+    nodes = informer_factory.nodes()
+
+    # scheduled pods -> cache (eventhandlers.go:356)
+    def add_pod_to_cache(pod: Pod) -> None:
+        try:
+            sched.cache.add_pod(pod)
+        except Exception:
+            logger.exception("add pod %s to cache", pod.key())
+        # Waking pods with matching affinity terms; moving all is a
+        # conservative superset of AssignedPodAdded (eventhandlers.go:90).
+        sched.queue.move_all_to_active_or_backoff_queue(events.AssignedPodAdd)
+
+    def update_pod_in_cache(old: Pod, new: Pod) -> None:
+        try:
+            sched.cache.update_pod(old, new)
+        except KeyError:
+            sched.cache.add_pod(new)
+        except Exception:
+            logger.exception("update pod %s in cache", new.key())
+        sched.queue.move_all_to_active_or_backoff_queue(events.AssignedPodUpdate)
+
+    def delete_pod_from_cache(pod: Pod) -> None:
+        try:
+            sched.cache.remove_pod(pod)
+        except Exception:
+            logger.exception("remove pod %s from cache", pod.key())
+        sched.queue.move_all_to_active_or_backoff_queue(events.AssignedPodDelete)
+
+    pods.add_event_handler(
+        ResourceEventHandler(
+            filter_func=_assigned,
+            on_add=add_pod_to_cache,
+            on_update=update_pod_in_cache,
+            on_delete=delete_pod_from_cache,
+        )
+    )
+
+    # unscheduled pods owned by one of our profiles -> queue (:381)
+    def add_pod_to_queue(pod: Pod) -> None:
+        sched.queue.add(pod)
+
+    def update_pod_in_queue(old: Pod, new: Pod) -> None:
+        sched.queue.update(old, new)
+
+    def delete_pod_from_queue(pod: Pod) -> None:
+        sched.queue.delete(pod)
+        for fw in sched.profiles.values():
+            fw.reject_waiting_pod(pod.metadata.uid)
+
+    pods.add_event_handler(
+        ResourceEventHandler(
+            filter_func=lambda p: not _assigned(p)
+            and _responsible_for_pod(sched, p),
+            on_add=add_pod_to_queue,
+            on_update=update_pod_in_queue,
+            on_delete=delete_pod_from_queue,
+        )
+    )
+
+    # nodes -> cache + queue wakeups (:406)
+    def add_node(node: Node) -> None:
+        sched.cache.add_node(node)
+        sched.queue.move_all_to_active_or_backoff_queue(events.NodeAdd)
+
+    def update_node(old: Node, new: Node) -> None:
+        sched.cache.update_node(old, new)
+        event = _node_scheduling_properties_changed(old, new)
+        if event:
+            sched.queue.move_all_to_active_or_backoff_queue(event)
+
+    def delete_node(node: Node) -> None:
+        sched.cache.remove_node(node)
+
+    nodes.add_event_handler(
+        ResourceEventHandler(
+            on_add=add_node, on_update=update_node, on_delete=delete_node
+        )
+    )
+
+
+def _node_scheduling_properties_changed(old: Node, new: Node) -> str:
+    """eventhandlers.go:445 nodeSchedulingPropertiesChange: only wake
+    pods when a property that can affect scheduling changed."""
+    if old.spec.unschedulable != new.spec.unschedulable:
+        return events.NodeSpecUnschedulableChange
+    if old.status.allocatable != new.status.allocatable:
+        return events.NodeAllocatableChange
+    if old.metadata.labels != new.metadata.labels:
+        return events.NodeLabelChange
+    if old.spec.taints != new.spec.taints:
+        return events.NodeTaintChange
+    if old.status.conditions != new.status.conditions:
+        return events.NodeConditionChange
+    return ""

@@ -1,0 +1,86 @@
+"""Default algorithm provider: the canonical plugin wiring.
+
+Reference: /root/reference/pkg/scheduler/algorithmprovider/registry.go:77
+(getDefaultConfig). Plugins not yet implemented in this build are noted and
+appended as they land; the TPU profile overlays this set via
+Plugins.apply().
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.config.types import Plugin as P, PluginSet, Plugins
+
+
+def default_plugins() -> Plugins:
+    return Plugins(
+        queue_sort=PluginSet(enabled=[P("PrioritySort")]),
+        pre_filter=PluginSet(
+            enabled=[
+                P("NodeResourcesFit"),
+                P("NodePorts"),
+                P("PodTopologySpread"),
+                P("InterPodAffinity"),
+            ]
+        ),
+        filter=PluginSet(
+            enabled=[
+                P("NodeUnschedulable"),
+                P("NodeResourcesFit"),
+                P("NodeName"),
+                P("NodePorts"),
+                P("NodeAffinity"),
+                P("TaintToleration"),
+                P("PodTopologySpread"),
+                P("InterPodAffinity"),
+            ]
+        ),
+        pre_score=PluginSet(
+            enabled=[
+                P("InterPodAffinity"),
+                P("PodTopologySpread"),
+                P("TaintToleration"),
+            ]
+        ),
+        score=PluginSet(
+            enabled=[
+                P("NodeResourcesBalancedAllocation", weight=1),
+                P("ImageLocality", weight=1),
+                P("InterPodAffinity", weight=1),
+                P("NodeResourcesLeastAllocated", weight=1),
+                P("NodeAffinity", weight=1),
+                P("NodePreferAvoidPods", weight=10000),
+                P("PodTopologySpread", weight=2),
+                P("TaintToleration", weight=1),
+            ]
+        ),
+        bind=PluginSet(enabled=[P("DefaultBinder")]),
+    )
+
+
+def minimal_plugins() -> Plugins:
+    """The SchedulingBasic slice: resource fit + allocation scorers only
+    (BASELINE.json config #1)."""
+    return Plugins(
+        queue_sort=PluginSet(enabled=[P("PrioritySort")]),
+        pre_filter=PluginSet(enabled=[P("NodeResourcesFit"), P("NodePorts")]),
+        filter=PluginSet(
+            enabled=[
+                P("NodeUnschedulable"),
+                P("NodeResourcesFit"),
+                P("NodeName"),
+                P("NodePorts"),
+                P("NodeAffinity"),
+                P("TaintToleration"),
+            ]
+        ),
+        pre_score=PluginSet(enabled=[P("TaintToleration")]),
+        score=PluginSet(
+            enabled=[
+                P("NodeResourcesBalancedAllocation", weight=1),
+                P("NodeResourcesLeastAllocated", weight=1),
+                P("NodeAffinity", weight=1),
+                P("TaintToleration", weight=1),
+            ]
+        ),
+        bind=PluginSet(enabled=[P("DefaultBinder")]),
+    )

@@ -1,0 +1,421 @@
+"""Scheduler: the scheduleOne control loop and its wiring.
+
+Reference: /root/reference/pkg/scheduler/scheduler.go (Scheduler struct :79,
+New :223, Run :363, scheduleOne :548, assume :474, bind :496,
+recordSchedulingFailure :375) and pkg/scheduler/profile/profile.go.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional
+
+from kubernetes_tpu.api.types import Pod, PodCondition
+from kubernetes_tpu.cache.cache import SchedulerCache
+from kubernetes_tpu.cache.snapshot import Snapshot
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.config.types import KubeSchedulerProfile, Plugins
+from kubernetes_tpu.framework.interface import (
+    CycleState,
+    FitError,
+    PodInfo,
+    Status,
+    StatusCode,
+)
+from kubernetes_tpu.framework.registry import Registry
+from kubernetes_tpu.framework.runtime import Framework
+from kubernetes_tpu.plugins import new_in_tree_registry
+from kubernetes_tpu.queue.scheduling_queue import PriorityQueue
+from kubernetes_tpu.scheduler.generic import GenericScheduler
+from kubernetes_tpu.scheduler.provider import default_plugins
+
+logger = logging.getLogger(__name__)
+
+
+class Scheduler:
+    def __init__(
+        self,
+        cache: SchedulerCache,
+        queue: PriorityQueue,
+        algorithm: GenericScheduler,
+        profiles: Dict[str, Framework],
+        client: Optional[Client] = None,
+        preemptor=None,
+        async_binding: bool = True,
+        bind_workers: int = 16,
+    ) -> None:
+        self.cache = cache
+        self.queue = queue
+        self.algorithm = algorithm
+        self.profiles = profiles
+        self.client = client
+        self.preemptor = preemptor  # set by stage-7 wiring
+        self.async_binding = async_binding
+        self._bind_pool = (
+            ThreadPoolExecutor(max_workers=bind_workers, thread_name_prefix="bind")
+            if async_binding
+            else None
+        )
+        self._stop = threading.Event()
+        self._inflight_binds = 0
+        self._inflight_lock = threading.Condition()
+
+    # -- profile lookup (scheduler.go:741 profileForPod) --------------------
+
+    def profile_for_pod(self, pod: Pod) -> Framework:
+        prof = self.profiles.get(pod.spec.scheduler_name)
+        if prof is None:
+            raise KeyError(
+                f"profile not found for scheduler name "
+                f"{pod.spec.scheduler_name!r}"
+            )
+        return prof
+
+    def _skip_pod_schedule(self, pod: Pod) -> bool:
+        """scheduler.go:750 skipPodSchedule: deleting or already assumed."""
+        if pod.metadata.deletion_timestamp is not None:
+            return True
+        if self.cache.is_assumed_pod(pod):
+            return True
+        return False
+
+    # -- failure path (scheduler.go:375 recordSchedulingFailure) ------------
+
+    def record_scheduling_failure(
+        self,
+        prof: Framework,
+        pod_info: PodInfo,
+        err_msg: str,
+        reason: str,
+        nominated_node: str,
+        pod_scheduling_cycle: int,
+    ) -> None:
+        pod = pod_info.pod
+        try:
+            self.queue.add_unschedulable_if_not_present(
+                pod_info, pod_scheduling_cycle
+            )
+        except KeyError:
+            pass  # already requeued via an informer update
+        if nominated_node:
+            self.queue.update_nominated_pod_for_node(pod, nominated_node)
+        if self.client is not None:
+            try:
+                def set_condition(p: Pod) -> None:
+                    p.status.conditions = [
+                        c for c in p.status.conditions if c.type != "PodScheduled"
+                    ] + [
+                        PodCondition(
+                            type="PodScheduled",
+                            status="False",
+                            reason=reason,
+                            message=err_msg,
+                        )
+                    ]
+                    if nominated_node:
+                        p.status.nominated_node_name = nominated_node
+
+                self.client.update_pod_status(
+                    pod.metadata.namespace, pod.metadata.name, set_condition
+                )
+            except Exception:
+                logger.exception("updating pod condition for %s", pod.key())
+
+    # -- assume (scheduler.go:474) ------------------------------------------
+
+    def assume(self, assumed: Pod, host: str) -> None:
+        assumed.spec.node_name = host
+        self.cache.assume_pod(assumed)
+        self.queue.delete_nominated_pod_if_exists(assumed)
+
+    # -- bind (scheduler.go:496) --------------------------------------------
+
+    def bind(
+        self, prof: Framework, state: CycleState, assumed: Pod, host: str
+    ) -> Optional[Status]:
+        for extender in self.algorithm.extenders:
+            if extender.is_binder() and extender.is_interested(assumed):
+                try:
+                    extender.bind(assumed, host)
+                    self.cache.finish_binding(assumed)
+                    return None
+                except Exception as e:
+                    return Status.error(str(e))
+        status = prof.run_bind_plugins(state, assumed, host)
+        self.cache.finish_binding(assumed)
+        if status is not None and status.code == StatusCode.SKIP:
+            return Status.error("no bind plugin handled the pod")
+        return status
+
+    # -- the loop -----------------------------------------------------------
+
+    def schedule_one(self, timeout: Optional[float] = None) -> bool:
+        """One iteration (scheduler.go:548). Returns False if no pod was
+        popped (timeout/closed)."""
+        pod_info = self.queue.pop(timeout=timeout)
+        if pod_info is None:
+            return False
+        pod_scheduling_cycle = self.queue.scheduling_cycle
+        pod = pod_info.pod
+        try:
+            prof = self.profile_for_pod(pod)
+        except KeyError as e:
+            logger.error("%s", e)
+            return True
+        if self._skip_pod_schedule(pod):
+            return True
+
+        state = CycleState()
+        start = time.perf_counter()
+        try:
+            result = self.algorithm.schedule(prof, state, pod)
+        except FitError as fit_err:
+            nominated_node = ""
+            if self.preemptor is not None:
+                try:
+                    nominated_node = self.preemptor.preempt(
+                        prof, state, pod, fit_err
+                    )
+                except Exception:
+                    logger.exception("preemption for %s failed", pod.key())
+            self.record_scheduling_failure(
+                prof,
+                pod_info,
+                str(fit_err),
+                "Unschedulable",
+                nominated_node,
+                pod_scheduling_cycle,
+            )
+            return True
+        except Exception as e:
+            logger.exception("scheduling %s failed", pod.key())
+            self.record_scheduling_failure(
+                prof, pod_info, str(e), "SchedulerError", "", pod_scheduling_cycle
+            )
+            return True
+
+        host = result.suggested_host
+        assumed = pod.deepcopy()
+
+        # Reserve
+        status = prof.run_reserve_plugins(state, assumed, host)
+        if status is not None and not status.is_success():
+            self.record_scheduling_failure(
+                prof, pod_info, status.message(), "SchedulerError", "",
+                pod_scheduling_cycle,
+            )
+            return True
+
+        # Assume: the pod occupies the node in cache from here on.
+        try:
+            self.assume(assumed, host)
+        except Exception as e:
+            prof.run_unreserve_plugins(state, assumed, host)
+            self.record_scheduling_failure(
+                prof, pod_info, str(e), "SchedulerError", "", pod_scheduling_cycle
+            )
+            return True
+
+        # Permit
+        status = prof.run_permit_plugins(state, assumed, host)
+        if (
+            status is not None
+            and not status.is_success()
+            and status.code != StatusCode.WAIT
+        ):
+            reason = (
+                "Unschedulable" if status.is_unschedulable() else "SchedulerError"
+            )
+            self._forget(assumed)
+            prof.run_unreserve_plugins(state, assumed, host)
+            self.record_scheduling_failure(
+                prof, pod_info, status.message(), reason, "", pod_scheduling_cycle
+            )
+            return True
+
+        # Binding cycle: async goroutine in the reference (scheduler.go:666).
+        if self._bind_pool is not None:
+            with self._inflight_lock:
+                self._inflight_binds += 1
+            self._bind_pool.submit(
+                self._binding_cycle_safe,
+                prof,
+                state,
+                pod_info,
+                assumed,
+                host,
+                pod_scheduling_cycle,
+            )
+        else:
+            self._binding_cycle(
+                prof, state, pod_info, assumed, host, pod_scheduling_cycle
+            )
+        return True
+
+    def _binding_cycle_safe(self, *args) -> None:
+        try:
+            self._binding_cycle(*args)
+        except Exception:
+            logger.exception("binding cycle crashed")
+        finally:
+            with self._inflight_lock:
+                self._inflight_binds -= 1
+                self._inflight_lock.notify_all()
+
+    def _binding_cycle(
+        self,
+        prof: Framework,
+        state: CycleState,
+        pod_info: PodInfo,
+        assumed: Pod,
+        host: str,
+        pod_scheduling_cycle: int,
+    ) -> None:
+        """scheduler.go:666-738: WaitOnPermit -> PreBind -> bind -> PostBind."""
+        status = prof.wait_on_permit(assumed)
+        if status is not None and not status.is_success():
+            reason = (
+                "Unschedulable" if status.is_unschedulable() else "SchedulerError"
+            )
+            self._forget(assumed)
+            prof.run_unreserve_plugins(state, assumed, host)
+            self.record_scheduling_failure(
+                prof, pod_info, status.message(), reason, "", pod_scheduling_cycle
+            )
+            return
+
+        status = prof.run_pre_bind_plugins(state, assumed, host)
+        if status is not None and not status.is_success():
+            self._forget(assumed)
+            prof.run_unreserve_plugins(state, assumed, host)
+            self.record_scheduling_failure(
+                prof, pod_info, status.message(), "SchedulerError", "",
+                pod_scheduling_cycle,
+            )
+            return
+
+        status = self.bind(prof, state, assumed, host)
+        if status is not None and not status.is_success():
+            self._forget(assumed)
+            prof.run_unreserve_plugins(state, assumed, host)
+            self.record_scheduling_failure(
+                prof, pod_info, status.message(), "SchedulerError", "",
+                pod_scheduling_cycle,
+            )
+            return
+        prof.run_post_bind_plugins(state, assumed, host)
+
+    def _forget(self, assumed: Pod) -> None:
+        try:
+            self.cache.forget_pod(assumed)
+        except Exception:
+            logger.exception("forgetting pod %s", assumed.key())
+
+    def wait_for_inflight_binds(self, timeout: float = 30.0) -> bool:
+        """Test/bench helper: block until async binding cycles drain."""
+        deadline = time.monotonic() + timeout
+        with self._inflight_lock:
+            while self._inflight_binds > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._inflight_lock.wait(remaining)
+        return True
+
+    def run(self) -> None:
+        """Blocking loop (scheduler.go:363)."""
+        self.queue.run()
+        while not self._stop.is_set():
+            self.schedule_one(timeout=0.5)
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(target=self.run, name="scheduler", daemon=True)
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.close()
+        if self._bind_pool is not None:
+            self._bind_pool.shutdown(wait=False)
+
+
+def new_scheduler(
+    client: Client,
+    informer_factory: InformerFactory,
+    profiles: Optional[List[KubeSchedulerProfile]] = None,
+    out_of_tree_registry: Optional[Registry] = None,
+    percentage_of_nodes_to_score: int = 0,
+    async_binding: bool = True,
+    cache_ttl_seconds: float = 30.0,
+    rng=None,
+) -> Scheduler:
+    """Build a fully wired scheduler (reference scheduler.go:223 New +
+    factory.go create)."""
+    registry = new_in_tree_registry()
+    registry.merge(out_of_tree_registry)
+
+    if not profiles:
+        profiles = [KubeSchedulerProfile()]
+
+    cache = SchedulerCache(ttl_seconds=cache_ttl_seconds)
+    snapshot = Snapshot()
+
+    frameworks: Dict[str, Framework] = {}
+    algorithm = GenericScheduler(
+        cache,
+        snapshot,
+        percentage_of_nodes_to_score=percentage_of_nodes_to_score,
+        rng=rng,
+    )
+    for profile_cfg in profiles:
+        plugins = default_plugins()
+        # prune defaults to registered plugins so the provider list can name
+        # plugins that land in later stages
+        plugins = _prune_unregistered(plugins, registry)
+        plugins = plugins.apply(profile_cfg.plugins)
+        fw = Framework(
+            registry,
+            plugins,
+            plugin_config=profile_cfg.plugin_config,
+            client=client,
+            snapshot_provider=lambda: snapshot,
+            informers=informer_factory,
+        )
+        frameworks[profile_cfg.scheduler_name] = fw
+
+    first_fw = next(iter(frameworks.values()))
+    queue = PriorityQueue(first_fw.queue_sort_less_func())
+    algorithm.nominated_pods_lister = queue
+
+    sched = Scheduler(
+        cache,
+        queue,
+        algorithm,
+        frameworks,
+        client=client,
+        async_binding=async_binding,
+    )
+    from kubernetes_tpu.scheduler.eventhandlers import add_all_event_handlers
+
+    add_all_event_handlers(sched, informer_factory)
+    return sched
+
+
+def _prune_unregistered(plugins: Plugins, registry: Registry) -> Plugins:
+    out = Plugins()
+    for point in Plugins.EXTENSION_POINTS:
+        ps = getattr(plugins, point)
+        setattr(
+            out,
+            point,
+            type(ps)(
+                enabled=[p for p in ps.enabled if p.name in registry],
+                disabled=list(ps.disabled),
+            ),
+        )
+    return out

@@ -1,0 +1,99 @@
+import pytest
+
+from kubernetes_tpu.api.types import Binding
+from kubernetes_tpu.apiserver import APIServer, Conflict, NotFound
+from kubernetes_tpu.apiserver.server import ADDED, DELETED, MODIFIED
+from kubernetes_tpu.client import Client
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def test_create_get_list_rv():
+    api = APIServer()
+    p = api.create(make_pod("p1").obj())
+    assert p.metadata.resource_version == 1
+    n = api.create(make_node("n1").obj())
+    assert n.metadata.resource_version == 2
+    pods, rv = api.list("Pod")
+    assert len(pods) == 1 and rv == 2
+
+
+def test_create_duplicate_conflict():
+    api = APIServer()
+    api.create(make_pod("p1").obj())
+    with pytest.raises(Conflict):
+        api.create(make_pod("p1").obj())
+
+
+def test_update_optimistic_concurrency():
+    api = APIServer()
+    p = api.create(make_pod("p1").obj())
+    rv = p.metadata.resource_version
+    p2 = make_pod("p1").labels(v="2").obj()
+    api.update(p2, expect_rv=rv)
+    stale = make_pod("p1").labels(v="3").obj()
+    with pytest.raises(Conflict):
+        api.update(stale, expect_rv=rv)
+
+
+def test_watch_streams_events_in_order():
+    api = APIServer()
+    w = api.watch("Pod")
+    api.create(make_pod("p1").obj())
+    api.guaranteed_update("Pod", "default", "p1", lambda p: None)
+    api.delete("Pod", "default", "p1")
+    types = [ev.type for ev in w.pending()]
+    assert types == [ADDED, MODIFIED, DELETED]
+
+
+def test_watch_since_rv_replays_history():
+    api = APIServer()
+    api.create(make_pod("p1").obj())
+    _, rv = api.list("Pod")
+    api.create(make_pod("p2").obj())
+    w = api.watch("Pod", since_rv=rv)
+    evs = w.pending()
+    assert [e.object.metadata.name for e in evs] == ["p2"]
+
+
+def test_binding_subresource():
+    api = APIServer()
+    client = Client(api)
+    pod = client.create_pod(make_pod("p1").obj())
+    client.create_node(make_node("n1").obj())
+    bound = client.bind(
+        Binding(pod_namespace="default", pod_name="p1", target_node="n1")
+    )
+    assert bound.spec.node_name == "n1"
+    # re-bind to a different node is a conflict
+    with pytest.raises(Conflict):
+        client.bind(Binding(pod_namespace="default", pod_name="p1", target_node="n2"))
+    # bind of a missing pod is NotFound
+    with pytest.raises(NotFound):
+        client.bind(Binding(pod_namespace="default", pod_name="nope", target_node="n1"))
+
+
+def test_binding_uid_mismatch():
+    api = APIServer()
+    client = Client(api)
+    client.create_pod(make_pod("p1").uid("uid-A").obj())
+    with pytest.raises(Conflict):
+        client.bind(
+            Binding(
+                pod_namespace="default",
+                pod_name="p1",
+                pod_uid="uid-B",
+                target_node="n1",
+            )
+        )
+
+
+def test_update_pod_status():
+    api = APIServer()
+    client = Client(api)
+    client.create_pod(make_pod("p1").obj())
+
+    def nominate(p):
+        p.status.nominated_node_name = "n5"
+
+    updated = client.update_pod_status("default", "p1", nominate)
+    assert updated.status.nominated_node_name == "n5"

@@ -1,0 +1,116 @@
+import random
+
+import pytest
+
+from kubernetes_tpu.cache.cache import SchedulerCache
+from kubernetes_tpu.cache.snapshot import Snapshot
+from kubernetes_tpu.config.types import KubeSchedulerProfile
+from kubernetes_tpu.framework.interface import CycleState, FitError
+from kubernetes_tpu.framework.runtime import Framework
+from kubernetes_tpu.plugins import new_in_tree_registry
+from kubernetes_tpu.queue.scheduling_queue import PriorityQueue
+from kubernetes_tpu.scheduler.generic import GenericScheduler
+from kubernetes_tpu.scheduler.provider import minimal_plugins
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _make(percentage=0, nominated=None):
+    cache = SchedulerCache()
+    gs = GenericScheduler(
+        cache,
+        Snapshot(),
+        percentage_of_nodes_to_score=percentage,
+        nominated_pods_lister=nominated,
+        rng=random.Random(42),
+    )
+    fw = Framework(new_in_tree_registry(), minimal_plugins(), client=None)
+    return cache, gs, fw
+
+
+def test_num_feasible_nodes_adaptive():
+    _, gs, _ = _make(percentage=0)
+    assert gs.num_feasible_nodes_to_find(50) == 50  # below min -> all
+    assert gs.num_feasible_nodes_to_find(100) == 100
+    # 5000 nodes: 50 - 5000/125 = 10% -> 500
+    assert gs.num_feasible_nodes_to_find(5000) == 500
+    # huge cluster hits the 5% floor
+    assert gs.num_feasible_nodes_to_find(100_000) == 5000
+    # percentage >= 100 disables truncation
+    _, gs2, _ = _make(percentage=100)
+    assert gs2.num_feasible_nodes_to_find(5000) == 5000
+    # small result floors at 100
+    _, gs3, _ = _make(percentage=1)
+    assert gs3.num_feasible_nodes_to_find(5000) == 100
+
+
+def test_select_host_ties_deterministic_with_seed():
+    _, gs, _ = _make()
+    pl = [("a", 10), ("b", 10), ("c", 5)]
+    picks = {gs.select_host(pl) for _ in range(50)}
+    assert picks <= {"a", "b"}
+    assert len(picks) == 2  # both ties get picked over 50 draws
+
+
+def test_schedule_picks_feasible_best():
+    cache, gs, fw = _make()
+    cache.add_node(make_node("small").capacity(cpu="1", memory="2Gi").obj())
+    cache.add_node(make_node("big").capacity(cpu="8", memory="32Gi").obj())
+    pod = make_pod("p").container(cpu="2", memory="4Gi").obj()
+    result = gs.schedule(fw, CycleState(), pod)
+    assert result.suggested_host == "big"
+    assert result.feasible_nodes == 1
+
+
+def test_schedule_no_nodes_raises_fit_error():
+    _, gs, fw = _make()
+    with pytest.raises(FitError) as exc:
+        gs.schedule(fw, CycleState(), make_pod("p").obj())
+    assert exc.value.num_all_nodes == 0
+
+
+def test_schedule_no_fit_collects_statuses():
+    cache, gs, fw = _make()
+    cache.add_node(make_node("n1").capacity(cpu="1", memory="1Gi").obj())
+    pod = make_pod("p").container(cpu="4", memory="4Gi").obj()
+    with pytest.raises(FitError) as exc:
+        gs.schedule(fw, CycleState(), pod)
+    statuses = exc.value.filtered_nodes_statuses
+    assert "n1" in statuses
+    assert "Insufficient cpu" in statuses["n1"].reasons
+
+
+def test_nominated_pods_two_pass_filtering():
+    """A node with a higher-priority nominated pod must reject a pod that
+    only fits without the nominated pod (generic_scheduler.go:598-616)."""
+    queue = PriorityQueue(lambda a, b: a.timestamp < b.timestamp)
+    cache, gs, fw = _make(nominated=queue)
+    cache.add_node(make_node("n1").capacity(cpu="4", memory="8Gi").obj())
+    # nominated pod (from a previous preemption) takes 3 cpu
+    nominated = make_pod("nom").priority(100).container(cpu="3", memory="1Gi").obj()
+    nominated.status.nominated_node_name = "n1"
+    queue.update_nominated_pod_for_node(nominated, "n1")
+
+    # incoming lower-priority pod needing 2 cpu: fits alone, not with nom
+    pod = make_pod("p").priority(0).container(cpu="2", memory="1Gi").obj()
+    with pytest.raises(FitError):
+        gs.schedule(fw, CycleState(), pod)
+
+    # a pod that fits alongside the nominated pod passes both passes
+    small = make_pod("small").priority(0).container(cpu="1", memory="1Gi").obj()
+    result = gs.schedule(fw, CycleState(), small)
+    assert result.suggested_host == "n1"
+
+
+def test_round_robin_start_index_advances_under_truncation():
+    """With search truncation active, successive cycles start filtering at
+    different nodes (generic_scheduler.go:456 nextStartNodeIndex)."""
+    cache, gs, fw = _make(percentage=40)
+    for i in range(150):
+        cache.add_node(make_node(f"n{i:03d}").capacity(cpu="4", memory="8Gi").obj())
+    pod = make_pod("p").container(cpu="1", memory="1Gi").obj()
+    # 150 * 40% = 60 -> floored to MIN_FEASIBLE_NODES_TO_FIND = 100
+    assert gs.num_feasible_nodes_to_find(150) == 100
+    gs.schedule(fw, CycleState(), pod)
+    assert gs.next_start_node_index == 100
+    gs.schedule(fw, CycleState(), make_pod("p2").container(cpu="1", memory="1Gi").obj())
+    assert gs.next_start_node_index == (100 + 100) % 150

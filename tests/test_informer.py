@@ -1,0 +1,85 @@
+import time
+
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import Client, InformerFactory, ResourceEventHandler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def test_informer_pump_list_then_events():
+    api = APIServer()
+    client = Client(api)
+    client.create_pod(make_pod("pre-existing").obj())
+
+    factory = InformerFactory(api)
+    added, updated, deleted = [], [], []
+    factory.pods().add_event_handler(
+        ResourceEventHandler(
+            on_add=lambda o: added.append(o.metadata.name),
+            on_update=lambda o, n: updated.append(n.metadata.name),
+            on_delete=lambda o: deleted.append(o.metadata.name),
+        )
+    )
+    factory.pump()
+    assert added == ["pre-existing"]
+
+    client.create_pod(make_pod("live").obj())
+    api.guaranteed_update("Pod", "default", "live", lambda p: None)
+    client.delete_pod("default", "live")
+    factory.pump()
+    assert added == ["pre-existing", "live"]
+    assert updated == ["live"]
+    assert deleted == ["live"]
+    # local store reflects state
+    assert [p.metadata.name for p in factory.pods().list()] == ["pre-existing"]
+
+
+def test_filtering_handler_transitions():
+    """Assigned/unassigned filter transitions: a pod that becomes assigned
+    must be delivered as delete to the unassigned handler and add to the
+    assigned handler (reference eventhandlers.go:356-404)."""
+    api = APIServer()
+    client = Client(api)
+    factory = InformerFactory(api)
+
+    unassigned_adds, unassigned_dels, assigned_adds = [], [], []
+    factory.pods().add_event_handler(
+        ResourceEventHandler(
+            filter_func=lambda p: not p.spec.node_name,
+            on_add=lambda o: unassigned_adds.append(o.metadata.name),
+            on_delete=lambda o: unassigned_dels.append(o.metadata.name),
+        )
+    )
+    factory.pods().add_event_handler(
+        ResourceEventHandler(
+            filter_func=lambda p: bool(p.spec.node_name),
+            on_add=lambda o: assigned_adds.append(o.metadata.name),
+        )
+    )
+    factory.pump()
+    client.create_pod(make_pod("p1").obj())
+    factory.pump()
+    assert unassigned_adds == ["p1"] and assigned_adds == []
+
+    from kubernetes_tpu.api.types import Binding
+
+    client.bind(Binding(pod_namespace="default", pod_name="p1", target_node="n1"))
+    factory.pump()
+    assert unassigned_dels == ["p1"]
+    assert assigned_adds == ["p1"]
+
+
+def test_informer_threaded_mode():
+    api = APIServer()
+    client = Client(api)
+    factory = InformerFactory(api)
+    seen = []
+    factory.nodes().add_event_handler(
+        ResourceEventHandler(on_add=lambda o: seen.append(o.metadata.name))
+    )
+    factory.start()
+    client.create_node(make_node("n1").obj())
+    deadline = time.time() + 2
+    while not seen and time.time() < deadline:
+        time.sleep(0.01)
+    factory.stop()
+    assert seen == ["n1"]

@@ -1,0 +1,274 @@
+"""Table-driven plugin tests (reference pattern: each plugin's *_test.go
+builds NodeInfo/pods via the wrapper DSL and calls Filter/Score directly)."""
+
+import pytest
+
+from kubernetes_tpu.cache.node_info import NodeInfo
+from kubernetes_tpu.cache.snapshot import new_snapshot
+from kubernetes_tpu.framework.interface import CycleState, StatusCode
+from kubernetes_tpu.plugins import (
+    imagelocality,
+    nodeaffinity,
+    nodename,
+    nodeports,
+    noderesources,
+    nodeunschedulable,
+    tainttoleration,
+)
+from kubernetes_tpu.scheduler.generic import SNAPSHOT_STATE_KEY
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _state_with_snapshot(pods, nodes):
+    snap = new_snapshot(pods, nodes)
+    state = CycleState()
+    state.write(SNAPSHOT_STATE_KEY, snap)
+    return state, snap
+
+
+# --- NodeResourcesFit ---------------------------------------------------
+
+
+class TestFit:
+    def _filter(self, pod, node_info, args=None):
+        plugin = noderesources.Fit(args)
+        state = CycleState()
+        plugin.pre_filter(state, pod)
+        return plugin.filter(state, pod, node_info)
+
+    def test_fits(self):
+        ni = NodeInfo(make_node("n").capacity(cpu="4", memory="8Gi").obj())
+        pod = make_pod("p").container(cpu="2", memory="4Gi").obj()
+        assert self._filter(pod, ni) is None
+
+    def test_insufficient_cpu_and_memory(self):
+        ni = NodeInfo(make_node("n").capacity(cpu="1", memory="1Gi").obj())
+        pod = make_pod("p").container(cpu="2", memory="4Gi").obj()
+        status = self._filter(pod, ni)
+        assert status.code == StatusCode.UNSCHEDULABLE
+        assert "Insufficient cpu" in status.reasons
+        assert "Insufficient memory" in status.reasons
+
+    def test_counts_existing_usage(self):
+        ni = NodeInfo(make_node("n").capacity(cpu="4", memory="8Gi").obj())
+        ni.add_pod(make_pod("existing").container(cpu="3", memory="1Gi").node("n").obj())
+        pod = make_pod("p").container(cpu="2", memory="1Gi").obj()
+        status = self._filter(pod, ni)
+        assert status is not None and "Insufficient cpu" in status.reasons
+
+    def test_init_container_max(self):
+        ni = NodeInfo(make_node("n").capacity(cpu="4", memory="8Gi").obj())
+        pod = make_pod("p").container(cpu="1", memory="1Gi").obj()
+        from kubernetes_tpu.api.types import Container, ResourceRequirements
+
+        pod.spec.init_containers.append(
+            Container(
+                name="init",
+                resources=ResourceRequirements(requests={"cpu": 5000}),
+            )
+        )
+        status = self._filter(pod, ni)
+        assert status is not None and "Insufficient cpu" in status.reasons
+
+    def test_pod_count_limit(self):
+        ni = NodeInfo(make_node("n").capacity(cpu="40", memory="80Gi", pods=1).obj())
+        ni.add_pod(make_pod("existing").container(cpu="1", memory="1Gi").node("n").obj())
+        pod = make_pod("p").container(cpu="1", memory="1Gi").obj()
+        status = self._filter(pod, ni)
+        assert status is not None and status.reasons[0].startswith("Too many pods")
+
+    def test_scalar_resources(self):
+        node = make_node("n").capacity(cpu="4", memory="8Gi").obj()
+        node.status.allocatable["nvidia.com/gpu"] = 2
+        ni = NodeInfo(node)
+        pod = make_pod("p").container(cpu="1", memory="1Gi").obj()
+        pod.spec.containers[0].resources.requests["nvidia.com/gpu"] = 4
+        status = self._filter(pod, ni)
+        assert status is not None and "Insufficient nvidia.com/gpu" in status.reasons
+
+    def test_zero_request_only_pod_count(self):
+        ni = NodeInfo(make_node("n").capacity(cpu="0", memory="0", pods=10).obj())
+        pod = make_pod("p").obj()  # no containers, no requests
+        assert self._filter(pod, ni) is None
+
+
+# --- scorers ------------------------------------------------------------
+
+
+def test_least_allocated_prefers_empty():
+    nodes = [
+        make_node("empty").capacity(cpu="4", memory="8Gi").obj(),
+        make_node("busy").capacity(cpu="4", memory="8Gi").obj(),
+    ]
+    busy_pod = make_pod("busy-pod").container(cpu="3", memory="6Gi").node("busy").obj()
+    state, _ = _state_with_snapshot([busy_pod], nodes)
+    plugin = noderesources.LeastAllocated()
+    pod = make_pod("p").container(cpu="1", memory="2Gi").obj()
+    s_empty, _ = plugin.score(state, pod, "empty")
+    s_busy, _ = plugin.score(state, pod, "busy")
+    assert s_empty > s_busy
+
+
+def test_balanced_allocation():
+    nodes = [make_node("n").capacity(cpu="4", memory="8Gi").obj()]
+    state, _ = _state_with_snapshot([], nodes)
+    plugin = noderesources.BalancedAllocation()
+    # perfectly balanced: 50% cpu, 50% mem
+    pod = make_pod("p").container(cpu="2", memory="4Gi").obj()
+    score, _ = plugin.score(state, pod, "n")
+    assert score == 100
+    # overcommitted -> 0
+    pod2 = make_pod("p2").container(cpu="8", memory="1Gi").obj()
+    score2, _ = plugin.score(state, pod2, "n")
+    assert score2 == 0
+
+
+def test_most_allocated_prefers_full():
+    nodes = [
+        make_node("empty").capacity(cpu="4", memory="8Gi").obj(),
+        make_node("busy").capacity(cpu="4", memory="8Gi").obj(),
+    ]
+    busy_pod = make_pod("b").container(cpu="2", memory="4Gi").node("busy").obj()
+    state, _ = _state_with_snapshot([busy_pod], nodes)
+    plugin = noderesources.MostAllocated()
+    pod = make_pod("p").container(cpu="1", memory="2Gi").obj()
+    s_empty, _ = plugin.score(state, pod, "empty")
+    s_busy, _ = plugin.score(state, pod, "busy")
+    assert s_busy > s_empty
+
+
+def test_requested_to_capacity_ratio_default_shape():
+    nodes = [make_node("n").capacity(cpu="4", memory="8Gi").obj()]
+    state, _ = _state_with_snapshot([], nodes)
+    plugin = noderesources.RequestedToCapacityRatio(None)
+    pod = make_pod("p").container(cpu="2", memory="4Gi").obj()
+    score, status = plugin.score(state, pod, "n")
+    assert status is None
+    assert score == 50  # 50% utilization on default 0->0, 100->10 curve
+
+
+# --- NodeName / NodePorts / NodeUnschedulable ---------------------------
+
+
+def test_node_name():
+    plugin = nodename.NodeName()
+    ni = NodeInfo(make_node("n1").obj())
+    ok = make_pod("p").node("n1").obj()
+    # NodeName filter reads spec.node_name as the *requested* hostname
+    assert plugin.filter(CycleState(), ok, ni) is None
+    bad = make_pod("p2").node("other").obj()
+    assert plugin.filter(CycleState(), bad, ni).code == StatusCode.UNSCHEDULABLE
+
+
+def test_node_ports_conflict():
+    plugin = nodeports.NodePorts()
+    ni = NodeInfo(make_node("n").capacity(cpu="4", memory="8Gi").obj())
+    ni.add_pod(
+        make_pod("existing").container(cpu="1", memory="1Gi", host_port=80).node("n").obj()
+    )
+    pod = make_pod("p").container(cpu="1", memory="1Gi", host_port=80).obj()
+    state = CycleState()
+    plugin.pre_filter(state, pod)
+    assert plugin.filter(state, pod, ni).code == StatusCode.UNSCHEDULABLE
+    pod2 = make_pod("p2").container(cpu="1", memory="1Gi", host_port=81).obj()
+    state2 = CycleState()
+    plugin.pre_filter(state2, pod2)
+    assert plugin.filter(state2, pod2, ni) is None
+
+
+def test_node_unschedulable():
+    plugin = nodeunschedulable.NodeUnschedulable()
+    ni = NodeInfo(make_node("n").unschedulable().obj())
+    pod = make_pod("p").obj()
+    status = plugin.filter(CycleState(), pod, ni)
+    assert status.code == StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE
+    tolerant = (
+        make_pod("p2")
+        .toleration(
+            key="node.kubernetes.io/unschedulable",
+            operator="Exists",
+            effect="NoSchedule",
+        )
+        .obj()
+    )
+    assert plugin.filter(CycleState(), tolerant, ni) is None
+
+
+# --- NodeAffinity -------------------------------------------------------
+
+
+def test_node_affinity_filter():
+    plugin = nodeaffinity.NodeAffinity()
+    zone1 = NodeInfo(make_node("z1").label("zone", "z1").obj())
+    zone2 = NodeInfo(make_node("z2").label("zone", "z2").obj())
+    pod = make_pod("p").node_affinity_in("zone", ["z1"]).obj()
+    assert plugin.filter(CycleState(), pod, zone1) is None
+    assert plugin.filter(CycleState(), pod, zone2).code == StatusCode.UNSCHEDULABLE
+    # plain nodeSelector
+    pod2 = make_pod("p2").node_selector(zone="z2").obj()
+    assert plugin.filter(CycleState(), pod2, zone1) is not None
+    assert plugin.filter(CycleState(), pod2, zone2) is None
+
+
+def test_node_affinity_preferred_score():
+    nodes = [
+        make_node("z1").label("zone", "z1").obj(),
+        make_node("z2").label("zone", "z2").obj(),
+    ]
+    state, _ = _state_with_snapshot([], nodes)
+    plugin = nodeaffinity.NodeAffinity()
+    pod = make_pod("p").preferred_node_affinity_in("zone", ["z1"], weight=5).obj()
+    s1, _ = plugin.score(state, pod, "z1")
+    s2, _ = plugin.score(state, pod, "z2")
+    assert s1 == 5 and s2 == 0
+
+
+# --- TaintToleration ----------------------------------------------------
+
+
+def test_taint_toleration_filter():
+    plugin = tainttoleration.TaintToleration()
+    tainted = NodeInfo(make_node("t").taint("dedicated", "gpu", "NoSchedule").obj())
+    pod = make_pod("p").obj()
+    status = plugin.filter(CycleState(), pod, tainted)
+    assert status.code == StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE
+    tolerant = make_pod("p2").toleration(key="dedicated", value="gpu").obj()
+    assert plugin.filter(CycleState(), tolerant, tainted) is None
+
+
+def test_taint_toleration_prefer_no_schedule_score():
+    nodes = [
+        make_node("clean").obj(),
+        make_node("pref").taint("soft", "x", "PreferNoSchedule").obj(),
+    ]
+    state, snap = _state_with_snapshot([], nodes)
+    plugin = tainttoleration.TaintToleration()
+    pod = make_pod("p").obj()
+    plugin.pre_score(state, pod, snap.list_node_infos())
+    from kubernetes_tpu.framework.interface import NodeScore
+
+    scores = []
+    for name in ("clean", "pref"):
+        s, _ = plugin.score(state, pod, name)
+        scores.append(NodeScore(name, s))
+    plugin.normalize_score(state, pod, scores)
+    by = {ns.name: ns.score for ns in scores}
+    assert by["clean"] == 100 and by["pref"] == 0
+
+
+# --- ImageLocality ------------------------------------------------------
+
+
+def test_image_locality_prefers_node_with_image():
+    big = 500 * 1024 * 1024
+    nodes = [
+        make_node("has").image("myimage", big).obj(),
+        make_node("hasnot").obj(),
+    ]
+    state, _ = _state_with_snapshot([], nodes)
+    plugin = imagelocality.ImageLocality()
+    pod = make_pod("p").container(cpu="1", memory="1Gi", image="myimage").obj()
+    s_has, _ = plugin.score(state, pod, "has")
+    s_not, _ = plugin.score(state, pod, "hasnot")
+    assert s_has > s_not
+    assert s_not == 0

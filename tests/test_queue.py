@@ -119,6 +119,28 @@ def test_nominated_pods():
     assert q.nominated_pods_for_node("n1") == []
 
 
+def test_status_only_update_keeps_pod_parked():
+    """The scheduler's own PodScheduled-condition write must not wake a
+    parked unschedulable pod (isPodUpdated guard, scheduling_queue.go)."""
+    from kubernetes_tpu.api.types import PodCondition
+
+    now = [0.0]
+    q = _pq(now)
+    q.add(make_pod("p1").obj())
+    pi = q.pop()
+    q.add_unschedulable_if_not_present(pi, q.scheduling_cycle)
+    old = pi.pod
+    new = make_pod("p1").obj()
+    new.status.conditions.append(PodCondition(type="PodScheduled", status="False"))
+    new.metadata.resource_version = 99
+    q.update(old, new)
+    assert q.num_pending() == {"active": 0, "backoff": 0, "unschedulable": 1}
+    # but a real spec change does wake it
+    labeled = make_pod("p1").labels(x="1").obj()
+    q.update(new, labeled)
+    assert q.num_pending()["unschedulable"] == 0
+
+
 def test_update_in_unschedulable_moves_to_active():
     now = [0.0]
     q = _pq(now)

@@ -1,0 +1,133 @@
+"""End-to-end: pods created via the API get bound by the scheduler loop.
+
+Mirrors the reference integration tests (test/integration/scheduler/) with
+the in-process API server standing in for apiserver+etcd.
+"""
+
+import random
+
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import Client, InformerFactory
+from kubernetes_tpu.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _setup(async_binding=False):
+    api = APIServer()
+    client = Client(api)
+    factory = InformerFactory(api)
+    sched = new_scheduler(
+        client,
+        factory,
+        async_binding=async_binding,
+        rng=random.Random(7),
+    )
+    factory.pump()
+    return api, client, factory, sched
+
+
+def _drive(sched, factory, max_iters=200):
+    """Pump informers and run scheduling iterations until idle."""
+    for _ in range(max_iters):
+        factory.pump()
+        if not sched.schedule_one(timeout=0.01):
+            if factory.pump() == 0:
+                break
+    factory.pump()
+
+
+def test_pods_get_bound():
+    api, client, factory, sched = _setup()
+    for i in range(3):
+        client.create_node(make_node(f"n{i}").capacity(cpu="4", memory="8Gi").obj())
+    for i in range(6):
+        client.create_pod(make_pod(f"p{i}").container(cpu="1", memory="1Gi").obj())
+    _drive(sched, factory)
+    pods, _ = client.list_pods()
+    assert all(p.spec.node_name for p in pods), [
+        (p.name, p.spec.node_name) for p in pods
+    ]
+    # spread over nodes by LeastAllocated: no node got everything
+    nodes_used = {p.spec.node_name for p in pods}
+    assert len(nodes_used) == 3
+
+
+def test_unschedulable_pod_retries_after_node_add():
+    api, client, factory, sched = _setup()
+    client.create_pod(make_pod("big").container(cpu="8", memory="1Gi").obj())
+    _drive(sched, factory)
+    pod = client.get_pod("default", "big")
+    assert not pod.spec.node_name
+    conditions = {c.type: c for c in pod.status.conditions}
+    assert conditions["PodScheduled"].status == "False"
+    assert conditions["PodScheduled"].reason == "Unschedulable"
+
+    # capacity arrives -> pod is woken and scheduled (after backoff)
+    client.create_node(make_node("huge").capacity(cpu="16", memory="32Gi").obj())
+    factory.pump()
+    sched.queue.flush_backoff_q_completed()
+    import time
+
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        factory.pump()
+        sched.queue.flush_backoff_q_completed()
+        if sched.schedule_one(timeout=0.05):
+            factory.pump()
+            pod = client.get_pod("default", "big")
+            if pod.spec.node_name:
+                break
+    assert client.get_pod("default", "big").spec.node_name == "huge"
+
+
+def test_higher_priority_scheduled_first_under_scarcity():
+    api, client, factory, sched = _setup()
+    client.create_node(make_node("n").capacity(cpu="2", memory="4Gi").obj())
+    client.create_pod(
+        make_pod("low").priority(1).container(cpu="2", memory="1Gi").obj()
+    )
+    client.create_pod(
+        make_pod("high").priority(10).container(cpu="2", memory="1Gi").obj()
+    )
+    _drive(sched, factory)
+    assert client.get_pod("default", "high").spec.node_name == "n"
+    assert not client.get_pod("default", "low").spec.node_name
+
+
+def test_node_selector_respected_e2e():
+    api, client, factory, sched = _setup()
+    client.create_node(
+        make_node("gpu-node").label("accel", "tpu").capacity(cpu="4", memory="8Gi").obj()
+    )
+    client.create_node(make_node("plain").capacity(cpu="4", memory="8Gi").obj())
+    client.create_pod(
+        make_pod("picky").node_selector(accel="tpu").container(cpu="1", memory="1Gi").obj()
+    )
+    _drive(sched, factory)
+    assert client.get_pod("default", "picky").spec.node_name == "gpu-node"
+
+
+def test_async_binding_mode():
+    api, client, factory, sched = _setup(async_binding=True)
+    client.create_node(make_node("n").capacity(cpu="8", memory="16Gi").obj())
+    for i in range(4):
+        client.create_pod(make_pod(f"p{i}").container(cpu="1", memory="1Gi").obj())
+    for _ in range(10):
+        factory.pump()
+        sched.schedule_one(timeout=0.05)
+    assert sched.wait_for_inflight_binds(timeout=5)
+    factory.pump()
+    pods, _ = client.list_pods()
+    assert all(p.spec.node_name for p in pods)
+    sched.stop()
+
+
+def test_tainted_node_avoided():
+    api, client, factory, sched = _setup()
+    client.create_node(
+        make_node("tainted").taint("dedicated", "infra").capacity(cpu="4", memory="8Gi").obj()
+    )
+    client.create_node(make_node("open").capacity(cpu="4", memory="8Gi").obj())
+    client.create_pod(make_pod("p").container(cpu="1", memory="1Gi").obj())
+    _drive(sched, factory)
+    assert client.get_pod("default", "p").spec.node_name == "open"
