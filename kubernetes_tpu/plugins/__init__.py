@@ -20,6 +20,7 @@ def new_in_tree_registry() -> Registry:
         nodepreferavoidpods,
         noderesources,
         nodeunschedulable,
+        podtopologyspread,
         queuesort,
         tainttoleration,
     )
@@ -64,5 +65,9 @@ def new_in_tree_registry() -> Registry:
     )
     r.register(
         defaultbinder.DefaultBinder.NAME, lambda a, h: defaultbinder.DefaultBinder(h)
+    )
+    r.register(
+        podtopologyspread.PodTopologySpread.NAME,
+        lambda a, h: podtopologyspread.PodTopologySpread(h),
     )
     return r

@@ -1,0 +1,213 @@
+"""PodTopologySpread plugin tests (reference pattern:
+podtopologyspread/filtering_test.go, scoring_test.go)."""
+
+from kubernetes_tpu.cache.snapshot import new_snapshot
+from kubernetes_tpu.framework.interface import CycleState, NodeScore
+from kubernetes_tpu.plugins.podtopologyspread import (
+    PRE_FILTER_STATE_KEY,
+    PodTopologySpread,
+)
+from kubernetes_tpu.scheduler.generic import SNAPSHOT_STATE_KEY
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _cluster_zones():
+    """2 zones x 2 nodes; app=web pods spread zone1=2 (1+1), zone2=1."""
+    nodes = [
+        make_node("n1a").labels(zone="zone1", host="n1a").obj(),
+        make_node("n1b").labels(zone="zone1", host="n1b").obj(),
+        make_node("n2a").labels(zone="zone2", host="n2a").obj(),
+        make_node("n2b").labels(zone="zone2", host="n2b").obj(),
+    ]
+    pods = [
+        make_pod("p1").node("n1a").labels(app="web").obj(),
+        make_pod("p2").node("n1b").labels(app="web").obj(),
+        make_pod("p3").node("n2a").labels(app="web").obj(),
+    ]
+    return pods, nodes
+
+
+def _run_filter(pod, pods, nodes):
+    snap = new_snapshot(pods, nodes)
+    state = CycleState()
+    state.write(SNAPSHOT_STATE_KEY, snap)
+    pl = PodTopologySpread()
+    assert pl.pre_filter(state, pod) is None
+    results = {}
+    for ni in snap.list_node_infos():
+        results[ni.node_name] = pl.filter(state, pod, ni)
+    return results, state, snap, pl
+
+
+class TestFilter:
+    def test_zone_spread_max_skew_1(self):
+        pods, nodes = _cluster_zones()
+        pod = (
+            make_pod("new")
+            .labels(app="web")
+            .spread_constraint(1, "zone", match_labels={"app": "web"})
+            .obj()
+        )
+        results, *_ = _run_filter(pod, pods, nodes)
+        # zone1 has 2 matches, zone2 has 1 (min). 2+1-1=2 > 1 -> zone1 out.
+        assert results["n1a"] is not None
+        assert results["n1b"] is not None
+        assert results["n2a"] is None
+        assert results["n2b"] is None
+
+    def test_node_missing_topology_key_unschedulable(self):
+        pods, nodes = _cluster_zones()
+        nodes.append(make_node("nx").obj())  # no zone label
+        pod = (
+            make_pod("new")
+            .labels(app="web")
+            .spread_constraint(1, "zone", match_labels={"app": "web"})
+            .obj()
+        )
+        results, *_ = _run_filter(pod, pods, nodes)
+        assert results["nx"] is not None
+
+    def test_non_matching_incoming_pod_no_self_skew(self):
+        pods, nodes = _cluster_zones()
+        # incoming pod does not match its own selector: selfMatch=0, so
+        # zone1 skew = 2+0-1 = 1 <= 1 -> fits everywhere.
+        pod = (
+            make_pod("new")
+            .labels(app="db")
+            .spread_constraint(1, "zone", match_labels={"app": "web"})
+            .obj()
+        )
+        results, *_ = _run_filter(pod, pods, nodes)
+        assert all(v is None for v in results.values())
+
+    def test_no_constraints_passes(self):
+        pods, nodes = _cluster_zones()
+        pod = make_pod("new").labels(app="web").obj()
+        results, *_ = _run_filter(pod, pods, nodes)
+        assert all(v is None for v in results.values())
+
+    def test_hostname_spread(self):
+        pods, nodes = _cluster_zones()
+        pod = (
+            make_pod("new")
+            .labels(app="web")
+            .spread_constraint(1, "host", match_labels={"app": "web"})
+            .obj()
+        )
+        results, *_ = _run_filter(pod, pods, nodes)
+        # per-host matches: n1a=1 n1b=1 n2a=1 n2b=0(min). skew for used
+        # hosts = 1+1-0 = 2 > 1 -> only n2b fits.
+        assert results["n2b"] is None
+        assert results["n1a"] is not None
+
+    def test_namespace_scoping(self):
+        pods, nodes = _cluster_zones()
+        for p in pods:
+            p.metadata.namespace = "other"
+        pod = (
+            make_pod("new")  # default namespace: no pods match
+            .labels(app="web")
+            .spread_constraint(1, "zone", match_labels={"app": "web"})
+            .obj()
+        )
+        results, *_ = _run_filter(pod, pods, nodes)
+        assert all(v is None for v in results.values())
+
+
+class TestPreFilterExtensions:
+    def test_add_remove_pod_updates_counts(self):
+        pods, nodes = _cluster_zones()
+        pod = (
+            make_pod("new")
+            .labels(app="web")
+            .spread_constraint(1, "zone", match_labels={"app": "web"})
+            .obj()
+        )
+        results, state, snap, pl = _run_filter(pod, pods, nodes)
+        ext = pl.pre_filter_extensions()
+        # virtually add a matching pod to zone2 -> zone2 now 2, min becomes 2
+        extra = make_pod("extra").node("n2b").labels(app="web").obj()
+        ni = snap.get_node_info("n2b")
+        ext.add_pod(state, pod, extra, ni)
+        s = state.read(PRE_FILTER_STATE_KEY)
+        assert s.tp_pair_to_match_num[("zone", "zone2")] == 2
+        assert s.tp_key_to_critical_paths["zone"].min_match_num() == 2
+        # zone1: 2+1-2=1 <= 1 -> now fits
+        assert pl.filter(state, pod, snap.get_node_info("n1a")) is None
+        # remove it again -> zone2 back to 1
+        ext.remove_pod(state, pod, extra, ni)
+        s = state.read(PRE_FILTER_STATE_KEY)
+        assert s.tp_pair_to_match_num[("zone", "zone2")] == 1
+        assert pl.filter(state, pod, snap.get_node_info("n1a")) is not None
+
+    def test_clone_isolates_state(self):
+        pods, nodes = _cluster_zones()
+        pod = (
+            make_pod("new")
+            .labels(app="web")
+            .spread_constraint(1, "zone", match_labels={"app": "web"})
+            .obj()
+        )
+        _, state, snap, pl = _run_filter(pod, pods, nodes)
+        cloned = state.clone()
+        extra = make_pod("extra").node("n2b").labels(app="web").obj()
+        pl.pre_filter_extensions().add_pod(
+            cloned, pod, extra, snap.get_node_info("n2b")
+        )
+        orig = state.read(PRE_FILTER_STATE_KEY)
+        assert orig.tp_pair_to_match_num[("zone", "zone2")] == 1
+
+
+class TestScore:
+    def _score(self, pod, pods, nodes):
+        snap = new_snapshot(pods, nodes)
+        state = CycleState()
+        state.write(SNAPSHOT_STATE_KEY, snap)
+        pl = PodTopologySpread()
+        infos = snap.list_node_infos()
+        assert pl.pre_score(state, pod, infos) is None
+        scores = []
+        for ni in infos:
+            raw, status = pl.score(state, pod, ni.node_name)
+            assert status is None
+            scores.append(NodeScore(ni.node_name, raw))
+        assert pl.normalize_score(state, pod, scores) is None
+        return {ns.name: ns.score for ns in scores}
+
+    def test_soft_spread_prefers_less_loaded_zone(self):
+        pods, nodes = _cluster_zones()
+        pod = (
+            make_pod("new")
+            .labels(app="web")
+            .spread_constraint(
+                1, "zone", when_unsatisfiable="ScheduleAnyway",
+                match_labels={"app": "web"},
+            )
+            .obj()
+        )
+        by_node = self._score(pod, pods, nodes)
+        assert by_node["n2a"] > by_node["n1a"]
+        assert by_node["n2b"] == by_node["n2a"]
+
+    def test_no_soft_constraints_all_max(self):
+        pods, nodes = _cluster_zones()
+        pod = make_pod("new").labels(app="web").obj()
+        by_node = self._score(pod, pods, nodes)
+        # no constraints: raw scores all 0, maxMinDiff heuristic yields 0s
+        assert set(by_node.values()) == {0}
+
+    def test_node_without_key_scores_zero(self):
+        pods, nodes = _cluster_zones()
+        nodes.append(make_node("nx").obj())
+        pod = (
+            make_pod("new")
+            .labels(app="web")
+            .spread_constraint(
+                1, "zone", when_unsatisfiable="ScheduleAnyway",
+                match_labels={"app": "web"},
+            )
+            .obj()
+        )
+        by_node = self._score(pod, pods, nodes)
+        assert by_node["nx"] == 0
+        assert by_node["n2a"] > 0
