@@ -14,6 +14,7 @@ def new_in_tree_registry() -> Registry:
     from kubernetes_tpu.plugins import (
         defaultbinder,
         imagelocality,
+        interpodaffinity,
         nodeaffinity,
         nodename,
         nodeports,
@@ -69,5 +70,9 @@ def new_in_tree_registry() -> Registry:
     r.register(
         podtopologyspread.PodTopologySpread.NAME,
         lambda a, h: podtopologyspread.PodTopologySpread(h),
+    )
+    r.register(
+        interpodaffinity.InterPodAffinity.NAME,
+        lambda a, h: interpodaffinity.InterPodAffinity(a, h),
     )
     return r

@@ -1,0 +1,192 @@
+"""InterPodAffinity plugin tests (reference pattern:
+interpodaffinity/filtering_test.go, scoring_test.go)."""
+
+from kubernetes_tpu.cache.snapshot import new_snapshot
+from kubernetes_tpu.framework.interface import CycleState, NodeScore, StatusCode
+from kubernetes_tpu.plugins.interpodaffinity import InterPodAffinity
+from kubernetes_tpu.scheduler.generic import SNAPSHOT_STATE_KEY
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _nodes():
+    return [
+        make_node("n1").labels(zone="z1", host="n1").obj(),
+        make_node("n2").labels(zone="z1", host="n2").obj(),
+        make_node("n3").labels(zone="z2", host="n3").obj(),
+    ]
+
+
+def _run_filter(pod, pods, nodes):
+    snap = new_snapshot(pods, nodes)
+    state = CycleState()
+    state.write(SNAPSHOT_STATE_KEY, snap)
+    pl = InterPodAffinity()
+    assert pl.pre_filter(state, pod) is None
+    return (
+        {ni.node_name: pl.filter(state, pod, ni) for ni in snap.list_node_infos()},
+        state,
+        snap,
+        pl,
+    )
+
+
+class TestFilterAffinity:
+    def test_affinity_to_existing_pod_zone(self):
+        pods = [make_pod("store").node("n1").labels(app="store").obj()]
+        pod = (
+            make_pod("web").labels(app="web")
+            .pod_affinity("zone", {"app": "store"})
+            .obj()
+        )
+        results, *_ = _run_filter(pod, pods, _nodes())
+        assert results["n1"] is None
+        assert results["n2"] is None  # same zone
+        assert results["n3"] is not None
+
+    def test_affinity_unmatched_is_unresolvable(self):
+        pods = [make_pod("store").node("n1").labels(app="store").obj()]
+        pod = (
+            make_pod("web").labels(app="web")
+            .pod_affinity("zone", {"app": "nothing"})
+            .obj()
+        )
+        results, *_ = _run_filter(pod, pods, _nodes())
+        for status in results.values():
+            assert status is not None
+            assert status.code == StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_first_pod_self_affinity_allowed(self):
+        # No pod matches, but the pod matches its own affinity terms:
+        # allowed everywhere (filtering.go:494).
+        pod = (
+            make_pod("web").labels(app="web")
+            .pod_affinity("zone", {"app": "web"})
+            .obj()
+        )
+        results, *_ = _run_filter(pod, [], _nodes())
+        assert all(v is None for v in results.values())
+
+    def test_first_pod_without_self_match_blocked(self):
+        pod = (
+            make_pod("web").labels(app="web")
+            .pod_affinity("zone", {"app": "store"})
+            .obj()
+        )
+        results, *_ = _run_filter(pod, [], _nodes())
+        assert all(v is not None for v in results.values())
+
+
+class TestFilterAntiAffinity:
+    def test_incoming_anti_affinity(self):
+        pods = [make_pod("a").node("n1").labels(app="a").obj()]
+        pod = (
+            make_pod("b").labels(app="b")
+            .pod_affinity("host", {"app": "a"}, anti=True)
+            .obj()
+        )
+        results, *_ = _run_filter(pod, pods, _nodes())
+        assert results["n1"] is not None
+        assert results["n1"].code == StatusCode.UNSCHEDULABLE
+        assert results["n2"] is None
+        assert results["n3"] is None
+
+    def test_existing_pod_anti_affinity_symmetry(self):
+        # existing pod on n1 has anti-affinity to app=web in its zone:
+        # incoming web pod must avoid all of z1.
+        existing = (
+            make_pod("guard").node("n1").labels(app="guard")
+            .pod_affinity("zone", {"app": "web"}, anti=True)
+            .obj()
+        )
+        pod = make_pod("web").labels(app="web").obj()
+        results, *_ = _run_filter(pod, [existing], _nodes())
+        assert results["n1"] is not None
+        assert results["n2"] is not None
+        assert results["n3"] is None
+
+    def test_namespace_scoping(self):
+        other = make_pod("a", namespace="other").node("n1").labels(app="a").obj()
+        pod = (
+            make_pod("b").labels(app="b")
+            .pod_affinity("host", {"app": "a"}, anti=True)
+            .obj()
+        )
+        results, *_ = _run_filter(pod, [other], _nodes())
+        # anti-affinity term defaults to pod's own namespace -> no match
+        assert all(v is None for v in results.values())
+
+
+class TestPreFilterExtensions:
+    def test_add_remove_updates_counts(self):
+        pods = []
+        pod = (
+            make_pod("b").labels(app="b")
+            .pod_affinity("host", {"app": "a"}, anti=True)
+            .obj()
+        )
+        results, state, snap, pl = _run_filter(pod, pods, _nodes())
+        assert all(v is None for v in results.values())
+        added = make_pod("a").node("n2").labels(app="a").obj()
+        ext = pl.pre_filter_extensions()
+        ext.add_pod(state, pod, added, snap.get_node_info("n2"))
+        assert pl.filter(state, pod, snap.get_node_info("n2")) is not None
+        ext.remove_pod(state, pod, added, snap.get_node_info("n2"))
+        assert pl.filter(state, pod, snap.get_node_info("n2")) is None
+
+
+class TestScore:
+    def _score(self, pod, pods, nodes, args=None):
+        snap = new_snapshot(pods, nodes)
+        state = CycleState()
+        state.write(SNAPSHOT_STATE_KEY, snap)
+        pl = InterPodAffinity(args)
+        infos = snap.list_node_infos()
+        assert pl.pre_score(state, pod, infos) is None
+        scores = []
+        for ni in infos:
+            raw, status = pl.score(state, pod, ni.node_name)
+            assert status is None
+            scores.append(NodeScore(ni.node_name, raw))
+        assert pl.normalize_score(state, pod, scores) is None
+        return {ns.name: ns.score for ns in scores}
+
+    def test_preferred_affinity_prefers_colocated_zone(self):
+        pods = [make_pod("store").node("n1").labels(app="store").obj()]
+        pod = (
+            make_pod("web").labels(app="web")
+            .preferred_pod_affinity("zone", {"app": "store"}, weight=5)
+            .obj()
+        )
+        by_node = self._score(pod, pods, _nodes())
+        assert by_node["n1"] == by_node["n2"] == 100
+        assert by_node["n3"] == 0
+
+    def test_preferred_anti_affinity_avoids_zone(self):
+        pods = [make_pod("noisy").node("n1").labels(app="noisy").obj()]
+        pod = (
+            make_pod("quiet").labels(app="quiet")
+            .preferred_pod_affinity("zone", {"app": "noisy"}, weight=3, anti=True)
+            .obj()
+        )
+        by_node = self._score(pod, pods, _nodes())
+        assert by_node["n3"] == 100
+        assert by_node["n1"] == by_node["n2"] == 0
+
+    def test_hard_affinity_symmetric_weight(self):
+        # existing pod has REQUIRED affinity matching the incoming pod:
+        # incoming pod is drawn toward it with hardPodAffinityWeight.
+        existing = (
+            make_pod("store").node("n3").labels(app="store")
+            .pod_affinity("zone", {"app": "web"})
+            .obj()
+        )
+        pod = make_pod("web").labels(app="web").obj()
+        by_node = self._score(pod, [existing], _nodes(), {"hard_pod_affinity_weight": 10})
+        assert by_node["n3"] == 100
+        assert by_node["n1"] == 0
+
+    def test_no_affinity_anywhere_scores_flat(self):
+        pods = [make_pod("p").node("n1").labels(app="p").obj()]
+        pod = make_pod("q").labels(app="q").obj()
+        by_node = self._score(pod, pods, _nodes())
+        assert set(by_node.values()) == {0}
