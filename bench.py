@@ -1,0 +1,127 @@
+"""Benchmark: 10k-pod burst onto 5k nodes, end-to-end through the full
+pipeline (apiserver -> informers -> queue -> TPU batch solver -> bind).
+
+Mirrors the reference's BenchmarkPerfScheduling SchedulingBasic config
+(/root/reference/test/integration/scheduler_perf/config/
+performance-config.yaml) and its throughput collector
+(test/integration/scheduler_perf/util.go:197). Baseline: the reference's
+enforced minimum sustained throughput of 30 pods/s
+(scheduler_perf/scheduler_test.go:41 threshold3K; see BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Env knobs: BENCH_NODES (default 5000), BENCH_PODS (default 10000),
+BENCH_BATCH (default 512).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_PODS_PER_SEC = 30.0  # reference threshold3K
+
+
+def main() -> None:
+    num_nodes = int(os.environ.get("BENCH_NODES", 5000))
+    num_pods = int(os.environ.get("BENCH_PODS", 10000))
+    max_batch = int(os.environ.get("BENCH_BATCH", 512))
+
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.client.client import Client
+    from kubernetes_tpu.client.informer import InformerFactory
+    from kubernetes_tpu.scheduler.scheduler import new_scheduler
+    from kubernetes_tpu.testing import make_node, make_pod
+
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(client, informers, batch=True, max_batch=max_batch)
+
+    for i in range(num_nodes):
+        client.create_node(
+            make_node(f"node-{i}")
+            .capacity(cpu="32", memory="64Gi", pods=110)
+            .obj()
+        )
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+
+    # Warm the JIT cache off the clock (first compile is slow).
+    warm = [
+        make_pod(f"warm-{i}").container(cpu="100m", memory="128Mi").obj()
+        for i in range(max_batch)
+    ]
+    for p in warm:
+        client.create_pod(p)
+    t = sched.start()
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        pods, _ = client.list_pods()
+        if all(p.spec.node_name for p in pods):
+            break
+        time.sleep(0.05)
+
+    # The measured burst.
+    burst = [
+        make_pod(f"burst-{i}")
+        .container(cpu="250m", memory="512Mi")
+        .obj()
+        for i in range(num_pods)
+    ]
+    start = time.perf_counter()
+    for p in burst:
+        client.create_pod(p)
+    bound = 0
+    deadline = time.time() + 600
+    while bound < num_pods + len(warm) and time.time() < deadline:
+        pods, _ = client.list_pods()
+        bound = sum(1 for p in pods if p.spec.node_name)
+        if bound >= num_pods + len(warm):
+            break
+        time.sleep(0.02)
+    sched.wait_for_inflight_binds(timeout=60)
+    elapsed = time.perf_counter() - start
+
+    pods, _ = client.list_pods()
+    scheduled = sum(1 for p in pods if p.spec.node_name) - len(warm)
+    sched.stop()
+    informers.stop()
+    if scheduled < num_pods:
+        print(
+            json.dumps(
+                {
+                    "metric": "pods_per_sec_burst",
+                    "value": 0.0,
+                    "unit": "pods/s",
+                    "vs_baseline": 0.0,
+                    "error": f"only {scheduled}/{num_pods} pods scheduled",
+                }
+            )
+        )
+        return
+
+    pods_per_sec = num_pods / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"pods_per_sec_"
+                    f"{f'{num_pods//1000}k' if num_pods >= 1000 else num_pods}"
+                    f"_burst_{num_nodes}_nodes"
+                ),
+                "value": round(pods_per_sec, 1),
+                "unit": "pods/s",
+                "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

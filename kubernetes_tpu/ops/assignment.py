@@ -1,0 +1,149 @@
+"""Batched assignment: priority-ordered greedy with on-device capacity
+replay.
+
+This is the TPU replacement for the serialized scheduleOne loop
+(/root/reference/pkg/scheduler/scheduler.go:548): instead of popping one
+pod, filtering/scoring all nodes, assuming, and repeating, a whole batch
+of pods is solved in one jitted ``lax.scan``. Each scan step is one pod's
+cycle -- feasibility mask, score matrix row, argmax -- and the carry
+replays the cache ``assume`` (internal/cache/cache.go:344 AssumePod): the
+chosen node's requested/non-zero-requested accumulators are bumped before
+the next pod is considered, so a batch can never double-book capacity
+(sequential-consistency inside the batch; SURVEY.md section 7 "hardest
+parts (a)").
+
+Pods must arrive in activeQ order (priority desc, then FIFO --
+queuesort/priority_sort.go) so the device replay equals the sequential
+order. Ties in the score argmax pick the lowest node index; the reference
+reservoir-samples among ties (generic_scheduler.go:242), so decisions are
+identical modulo tie-break RNG.
+
+Sharding: all ``[N, ...]`` operands carry a node-axis sharding; under a
+``jax.sharding.Mesh`` the per-step mask/score map is embarrassingly
+parallel over node shards and XLA inserts the argmax all-reduce over ICI
+(SURVEY.md section 2.5: data parallelism over the node axis).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops.scores import (
+    balanced_allocation_score,
+    least_allocated_score,
+    most_allocated_score,
+)
+
+NO_NODE = -1
+
+
+@dataclass(frozen=True)
+class GreedyConfig:
+    """Score-plugin weights (mirrors the default provider's Score list,
+    algorithmprovider/registry.go:118: LeastAllocated w1 +
+    BalancedAllocation w1; MostAllocated for bin-packing profiles)."""
+
+    least_allocated_weight: int = 1
+    balanced_allocation_weight: int = 1
+    most_allocated_weight: int = 0
+
+
+@partial(jax.jit, static_argnames=("config",))
+def greedy_assign(
+    allocatable: jnp.ndarray,  # [N, R] int32
+    requested: jnp.ndarray,  # [N, R] int32 (batch-start state)
+    nzr: jnp.ndarray,  # [N, 2] int32 non-zero requested (cpu, memKiB)
+    valid: jnp.ndarray,  # [N] bool
+    pod_requests: jnp.ndarray,  # [B, R] int32, in solve order
+    pod_nzr: jnp.ndarray,  # [B, 2] int32, in solve order
+    static_mask: jnp.ndarray,  # [B, N] bool host-side label filters
+    active: jnp.ndarray,  # [B] bool (False for padding rows)
+    config: GreedyConfig = GreedyConfig(),
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Returns (assignment [B] int32 node index or NO_NODE,
+    requested' [N, R], nzr' [N, 2]) -- the post-batch node state so the
+    host can incrementally reconcile instead of repacking."""
+    caps = allocatable[:, :2]  # (milliCPU, memKiB) capacities for scorers
+    n = allocatable.shape[0]
+    node_iota = jnp.arange(n, dtype=jnp.int32)
+
+    def step(carry, inputs):
+        req_state, nzr_state = carry
+        pod_req, p_nzr, smask, is_active = inputs
+
+        free = allocatable - req_state
+        fits = ((pod_req[None, :] <= free) | (pod_req[None, :] == 0)).all(
+            axis=-1
+        )
+        feasible = fits & smask & valid
+
+        score = jnp.zeros((n,), dtype=jnp.float32)
+        if config.least_allocated_weight:
+            score += config.least_allocated_weight * least_allocated_score(
+                caps, nzr_state, p_nzr[None, :]
+            )[0]
+        if config.balanced_allocation_weight:
+            score += (
+                config.balanced_allocation_weight
+                * balanced_allocation_score(caps, nzr_state, p_nzr[None, :])[0]
+            )
+        if config.most_allocated_weight:
+            score += config.most_allocated_weight * most_allocated_score(
+                caps, nzr_state, p_nzr[None, :]
+            )[0]
+
+        score = jnp.where(feasible, score, -jnp.inf)
+        choice = jnp.argmax(score).astype(jnp.int32)
+        placed = feasible.any() & is_active
+        assignment = jnp.where(placed, choice, NO_NODE)
+
+        chosen = (node_iota == choice) & placed
+        req_state = req_state + chosen[:, None] * pod_req[None, :]
+        nzr_state = nzr_state + chosen[:, None] * p_nzr[None, :]
+        return (req_state, nzr_state), assignment
+
+    (req_out, nzr_out), assignments = jax.lax.scan(
+        step,
+        (requested, nzr),
+        (pod_requests, pod_nzr, static_mask, active),
+    )
+    return assignments, req_out, nzr_out
+
+
+def make_sharded_solver(mesh: "jax.sharding.Mesh", config: GreedyConfig = GreedyConfig()):
+    """Build a node-axis-sharded greedy solver for a device mesh.
+
+    Sharding layout (SURVEY.md section 2.5: data parallelism over the node
+    axis, the TPU analogue of ParallelizeUntil's 16 goroutines): every
+    ``[N, ...]`` operand is split over the ``nodes`` mesh axis, pod-batch
+    operands are replicated, and XLA inserts the ICI collectives for the
+    cross-shard argmax inside the scan. N must be a multiple of the mesh
+    size (NodeTensorCache pads to 128 rows).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    node = NamedSharding(mesh, P("nodes"))
+    node2d = NamedSharding(mesh, P("nodes", None))
+    batch_by_node = NamedSharding(mesh, P(None, "nodes"))
+    repl = NamedSharding(mesh, P())
+
+    def solve(allocatable, requested, nzr, valid, pod_requests, pod_nzr,
+              static_mask, active):
+        return greedy_assign(
+            allocatable, requested, nzr, valid,
+            pod_requests, pod_nzr, static_mask, active, config=config,
+        )
+
+    return jax.jit(
+        solve,
+        in_shardings=(
+            node2d, node2d, node2d, node,  # node-axis state
+            repl, repl, batch_by_node, repl,  # pod batch
+        ),
+        out_shardings=(repl, node2d, node2d),
+    )

@@ -1,0 +1,112 @@
+"""Host-side static feasibility masks for label-dependent filters.
+
+Strings don't exist on device (SURVEY.md section 7 "hardest parts (c)"),
+so the label-dependent Filter plugins -- NodeUnschedulable, NodeName,
+NodeAffinity/nodeSelector, TaintToleration(NoSchedule) -- are evaluated on
+the host into a ``[B, N]`` boolean mask the solver consumes. These checks
+depend only on (pod spec, node spec), not on what else the batch places,
+so they are safely hoisted out of the device replay loop.
+
+Cost control: pods sharing a constraint signature (same selector/affinity/
+toleration/nodeName shape) share one mask row, so the work is
+O(distinct_templates x N), not O(B x N) -- the batch analogue of the
+reference evaluating per pod with 16 goroutines
+(generic_scheduler.go:490).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api.types import (
+    Pod,
+    TAINT_EFFECT_NO_EXECUTE,
+    TAINT_EFFECT_NO_SCHEDULE,
+    Taint,
+)
+from kubernetes_tpu.cache.snapshot import Snapshot
+from kubernetes_tpu.plugins.nodeaffinity import (
+    pod_matches_node_selector_and_affinity,
+)
+from kubernetes_tpu.plugins.nodeunschedulable import TAINT_NODE_UNSCHEDULABLE
+from kubernetes_tpu.tensors.node_tensor import NodeTensor
+
+_UNSCHEDULABLE_TAINT = Taint(
+    key=TAINT_NODE_UNSCHEDULABLE, effect=TAINT_EFFECT_NO_SCHEDULE
+)
+
+
+def _constraint_signature(pod: Pod) -> Tuple:
+    """Pods with equal signatures produce identical static mask rows."""
+    spec = pod.spec
+    sel = tuple(sorted(spec.node_selector.items()))
+    aff = ()
+    if spec.affinity is not None and spec.affinity.node_affinity is not None:
+        na = spec.affinity.node_affinity
+        if na.required_during_scheduling is not None:
+            aff = tuple(
+                (
+                    tuple(
+                        (r.key, r.operator, tuple(r.values))
+                        for r in term.match_expressions
+                    ),
+                    tuple(
+                        (r.key, r.operator, tuple(r.values))
+                        for r in term.match_fields
+                    ),
+                )
+                for term in na.required_during_scheduling.node_selector_terms
+            )
+    tols = tuple(
+        (t.key, t.operator, t.value, t.effect) for t in spec.tolerations
+    )
+    return (spec.node_name, sel, aff, tols)
+
+
+def _tolerates_node_taints(pod: Pod, node) -> bool:
+    """tainttoleration filter semantics: every NoSchedule/NoExecute taint
+    must be tolerated (v1/toleration.go + tainttoleration plugin)."""
+    for taint in node.spec.taints:
+        if taint.effect not in (TAINT_EFFECT_NO_SCHEDULE, TAINT_EFFECT_NO_EXECUTE):
+            continue
+        if not any(t.tolerates(taint) for t in pod.spec.tolerations):
+            return False
+    return True
+
+
+def static_mask(
+    pods: List[Pod], snapshot: Snapshot, nt: NodeTensor
+) -> np.ndarray:
+    """[B, capacity] bool: label-level feasibility per (pod, node)."""
+    infos = snapshot.list_node_infos()
+    out = np.zeros((len(pods), nt.capacity), dtype=bool)
+    cache: Dict[Tuple, np.ndarray] = {}
+    for b, pod in enumerate(pods):
+        sig = _constraint_signature(pod)
+        row = cache.get(sig)
+        if row is None:
+            row = np.zeros(nt.capacity, dtype=bool)
+            # snapshot order == tensor row order (NodeTensorCache packs
+            # rows from the same list)
+            for j, ni in enumerate(infos):
+                node = ni.node
+                if node is None:
+                    continue
+                # same fake-taint check as the NodeUnschedulable plugin
+                if node.spec.unschedulable and not any(
+                    t.tolerates(_UNSCHEDULABLE_TAINT)
+                    for t in pod.spec.tolerations
+                ):
+                    continue
+                if pod.spec.node_name and pod.spec.node_name != node.metadata.name:
+                    continue
+                if not pod_matches_node_selector_and_affinity(pod, ni):
+                    continue
+                if not _tolerates_node_taints(pod, node):
+                    continue
+                row[j] = True
+            cache[sig] = row
+        out[b] = row
+    return out

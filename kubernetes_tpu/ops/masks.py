@@ -1,0 +1,25 @@
+"""Vectorized feasibility masks (the Filter extension point, tensorized).
+
+Reference semantics: noderesources/fit.go:181 fitsRequest -- a node fails
+when any requested dimension exceeds ``allocatable - requested``; zero
+requested dimensions are never checked (so an already-overcommitted node
+still accepts zero-request pods), and the pod-count dimension is always
+checked (every pod "requests" one pod slot).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def fit_mask(
+    allocatable: jnp.ndarray,  # [N, R] int32
+    requested: jnp.ndarray,  # [N, R] int32
+    pod_requests: jnp.ndarray,  # [B, R] int32 (col PODS == 1)
+    valid: jnp.ndarray,  # [N] bool
+) -> jnp.ndarray:
+    """[B, N] bool: True where the pod fits the node's free resources."""
+    free = (allocatable - requested)[None, :, :]  # [1, N, R]
+    req = pod_requests[:, None, :]  # [B, 1, R]
+    ok = (req <= free) | (req == 0)
+    return ok.all(axis=-1) & valid[None, :]

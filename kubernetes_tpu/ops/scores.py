@@ -1,0 +1,62 @@
+"""Vectorized Score matrices (the Score extension point, tensorized).
+
+Reference semantics: noderesources/resource_allocation.go:135 score base,
+least_allocated.go ((cap-req)*100/cap averaged over cpu+mem, integer
+floor), most_allocated.go (inverse), balanced_allocation.go:83
+(100*(1-|cpuFrac-memFrac|)).
+
+Inputs are the non-zero request aggregates (util/non_zero.go defaults:
+pods with no requests still count 100m/200Mi toward these heuristics) --
+``nzr`` is the node's running total, ``pod_nzr`` the incoming pod's.
+
+Integer floor divisions are evaluated in float32 with a +1e-4 epsilon
+before flooring: exact for every realistic quantity (relative f32 error
+~1e-7 over scores bounded by 100) without needing int64 on device.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+MAX_NODE_SCORE = 100.0
+_EPS = 1e-4
+
+
+def _fractions(
+    caps: jnp.ndarray,  # [N, 2] int32 (milliCPU, memKiB)
+    nzr: jnp.ndarray,  # [N, 2] int32
+    pod_nzr: jnp.ndarray,  # [B, 2] int32
+) -> jnp.ndarray:
+    """[B, N, 2] float32 requested/capacity fractions (inf-safe)."""
+    req = nzr[None, :, :] + pod_nzr[:, None, :]
+    cap = caps[None, :, :].astype(jnp.float32)
+    return req.astype(jnp.float32), cap
+
+
+def least_allocated_score(caps, nzr, pod_nzr) -> jnp.ndarray:
+    """[B, N] float32 in [0, 100]."""
+    req, cap = _fractions(caps, nzr, pod_nzr)
+    raw = jnp.floor((cap - req) * MAX_NODE_SCORE / jnp.maximum(cap, 1.0) + _EPS)
+    per_dim = jnp.where((cap == 0) | (req > cap), 0.0, raw)
+    return jnp.floor(per_dim.sum(axis=-1) / 2.0 + _EPS)
+
+
+def most_allocated_score(caps, nzr, pod_nzr) -> jnp.ndarray:
+    """[B, N] float32 in [0, 100]."""
+    req, cap = _fractions(caps, nzr, pod_nzr)
+    raw = jnp.floor(req * MAX_NODE_SCORE / jnp.maximum(cap, 1.0) + _EPS)
+    per_dim = jnp.where((cap == 0) | (req > cap), 0.0, raw)
+    return jnp.floor(per_dim.sum(axis=-1) / 2.0 + _EPS)
+
+
+def balanced_allocation_score(caps, nzr, pod_nzr) -> jnp.ndarray:
+    """[B, N] float32 in [0, 100]."""
+    req, cap = _fractions(caps, nzr, pod_nzr)
+    frac = jnp.where(cap == 0, 1.0, req / jnp.maximum(cap, 1.0))
+    cpu_frac = frac[..., 0]
+    mem_frac = frac[..., 1]
+    diff = jnp.abs(cpu_frac - mem_frac)
+    # epsilon guards the equal-fractions case against f32 rounding; the
+    # oracle's float64 truncation artifacts can still differ by at most 1
+    score = jnp.trunc((1.0 - diff) * MAX_NODE_SCORE + _EPS)
+    return jnp.where((cpu_frac >= 1.0) | (mem_frac >= 1.0), 0.0, score)

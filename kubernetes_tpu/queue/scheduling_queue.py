@@ -308,7 +308,11 @@ class PriorityQueue:
             )
 
     def run(self) -> List[threading.Thread]:
-        """Start the two flush loops as daemon threads."""
+        """Start the two flush loops as daemon threads. Idempotent: a
+        second call (Scheduler.run calls this too) is a no-op so the first
+        pair of flush threads is never orphaned."""
+        if getattr(self, "_flush_threads", None):
+            return self._flush_threads
         stop = threading.Event()
         self._stop_flush = stop
 
@@ -331,6 +335,7 @@ class PriorityQueue:
         ]
         for t in threads:
             t.start()
+        self._flush_threads = threads
         return threads
 
     def close(self) -> None:

@@ -1,0 +1,179 @@
+"""BatchScheduler: the TPU fast path -- drain the activeQ as a batch and
+solve placement on device.
+
+This is the north-star replacement for the reference's serialized
+scheduleOne loop (/root/reference/pkg/scheduler/scheduler.go:548): the
+activeQ drain becomes the batch (SURVEY.md section 2.1 "TPU equivalent"),
+the NodeInfo snapshot becomes an incrementally-updated NodeTensor, the
+Filter/Score plugins become the device mask/score matrices + host static
+mask, and selectHost becomes the argmax inside the assignment scan.
+
+The scheduling-framework contract stays intact: Reserve, Permit
+(gang-scheduling hook), PreBind, Bind and the failure/Unreserve paths run
+through the same Framework pipeline per pod (finish_schedule). Pods with
+constraints the solver doesn't model yet -- inter-pod (anti-)affinity,
+topology spread, host ports -- fall back to the sequential oracle path
+(attempt_schedule), exactly like the reference runs unsupported pods
+through extenders.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.framework.interface import CycleState, FitError, PodInfo
+from kubernetes_tpu.ops.assignment import GreedyConfig, NO_NODE, greedy_assign
+from kubernetes_tpu.ops.host_masks import static_mask
+from kubernetes_tpu.scheduler.generic import SNAPSHOT_STATE_KEY
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.tensors import NodeTensorCache, pack_pod_batch
+
+logger = logging.getLogger(__name__)
+
+POD_BUCKET = 64  # batch padded to a multiple of this to bound re-JITs
+
+
+def solver_supported(pod: Pod) -> bool:
+    """Constraints the device solver models today. Anything else falls
+    back to the sequential path (still fully correct, just not batched)."""
+    spec = pod.spec
+    if spec.topology_spread_constraints:
+        return False
+    a = spec.affinity
+    if a is not None and (
+        a.pod_affinity is not None or a.pod_anti_affinity is not None
+    ):
+        return False
+    for c in spec.containers:
+        for p in c.ports:
+            if p.host_port:
+                return False
+    return True
+
+
+class BatchScheduler(Scheduler):
+    def __init__(
+        self,
+        *args,
+        max_batch: int = 256,
+        solver_config: GreedyConfig = GreedyConfig(),
+        tensor_cache: Optional[NodeTensorCache] = None,
+        **kwargs,
+    ) -> None:
+        super().__init__(*args, **kwargs)
+        self.max_batch = max_batch
+        self.solver_config = solver_config
+        self.tensor_cache = tensor_cache or NodeTensorCache()
+        self.batches_solved = 0
+        self.pods_solved_on_device = 0
+        self.pods_fallback = 0
+
+    # -- one batch ----------------------------------------------------------
+
+    def schedule_batch(self, timeout: Optional[float] = None) -> int:
+        """Pop up to max_batch pods, solve device-supported ones in one
+        jitted call, route the rest through the sequential path. Returns
+        the number of pods processed."""
+        batch_infos = self.queue.pop_batch(self.max_batch, timeout=timeout)
+        if not batch_infos:
+            return 0
+        pod_scheduling_cycle = self.queue.scheduling_cycle
+
+        # Process in activeQ order: a fallback pod must not jump ahead of
+        # higher-priority solver pods popped before it, so solver runs are
+        # flushed at each fallback boundary (each flush re-snapshots, so
+        # fallback capacity claims are visible to later solver pods).
+        solver_infos: List[PodInfo] = []
+
+        def flush() -> None:
+            if solver_infos:
+                self._solve_and_commit(solver_infos, pod_scheduling_cycle)
+                self.batches_solved += 1
+                solver_infos.clear()
+
+        for pi in batch_infos:
+            if self._skip_pod_schedule(pi.pod):
+                continue
+            if solver_supported(pi.pod):
+                solver_infos.append(pi)
+            else:
+                flush()
+                self.pods_fallback += 1
+                self.attempt_schedule(pi)
+        flush()
+        return len(batch_infos)
+
+    def _solve_and_commit(
+        self, solver_infos: List[PodInfo], pod_scheduling_cycle: int
+    ) -> None:
+        snapshot = self.algorithm.snapshot
+        self.cache.update_snapshot(snapshot)
+        nt = self.tensor_cache.update(snapshot)
+        pods = [pi.pod for pi in solver_infos]
+        batch = pack_pod_batch(
+            pods, nt.dims, timestamps=[pi.timestamp for pi in solver_infos]
+        )
+        smask = static_mask(pods, snapshot, nt)
+        # pods requesting resources no node advertises are unsatisfiable
+        smask[batch.unsatisfiable] = False
+
+        b = batch.size
+        padded = POD_BUCKET * math.ceil(b / POD_BUCKET)
+        order = batch.order
+        req = np.zeros((padded, nt.dims.num_dims), dtype=np.int32)
+        nzr = np.zeros((padded, 2), dtype=np.int32)
+        sm = np.zeros((padded, nt.capacity), dtype=bool)
+        active = np.zeros(padded, dtype=bool)
+        req[:b] = batch.requests[order]
+        nzr[:b] = batch.non_zero_requests[order]
+        sm[:b] = smask[order]
+        active[:b] = True
+
+        assignments, _, _ = greedy_assign(
+            jnp.asarray(nt.allocatable),
+            jnp.asarray(nt.requested),
+            jnp.asarray(nt.non_zero_requested),
+            jnp.asarray(nt.valid),
+            jnp.asarray(req),
+            jnp.asarray(nzr),
+            jnp.asarray(sm),
+            jnp.asarray(active),
+            config=self.solver_config,
+        )
+        assignments = np.asarray(assignments)
+
+        num_nodes = nt.num_nodes
+        for k in range(b):
+            pi = solver_infos[int(order[k])]
+            choice = int(assignments[k])
+            prof = self.profiles.get(pi.pod.spec.scheduler_name)
+            if prof is None:
+                logger.error("no profile for %s", pi.pod.key())
+                continue
+            state = CycleState()
+            state.write(SNAPSHOT_STATE_KEY, snapshot)
+            if choice == NO_NODE:
+                fit_err = FitError(pi.pod, num_nodes, {})
+                self.handle_fit_error(
+                    prof, state, pi, fit_err, pod_scheduling_cycle
+                )
+                self.pods_solved_on_device += 1
+                continue
+            self.finish_schedule(
+                prof, state, pi, nt.names[choice], pod_scheduling_cycle
+            )
+            self.pods_solved_on_device += 1
+
+    # -- loop ---------------------------------------------------------------
+
+    def run(self) -> None:
+        self.queue.run()
+        while not self._stop.is_set():
+            self.schedule_batch(timeout=0.5)

@@ -159,46 +159,80 @@ class Scheduler:
         pod_info = self.queue.pop(timeout=timeout)
         if pod_info is None:
             return False
+        self.attempt_schedule(pod_info)
+        return True
+
+    def handle_fit_error(
+        self,
+        prof: Framework,
+        state: CycleState,
+        pod_info: PodInfo,
+        fit_err: FitError,
+        pod_scheduling_cycle: int,
+    ) -> None:
+        """FitError branch of scheduleOne (scheduler.go:581-591):
+        try preemption, then record the failure + nomination."""
+        pod = pod_info.pod
+        nominated_node = ""
+        if self.preemptor is not None:
+            try:
+                nominated_node = self.preemptor.preempt(
+                    prof, state, pod, fit_err
+                )
+            except Exception:
+                logger.exception("preemption for %s failed", pod.key())
+        self.record_scheduling_failure(
+            prof,
+            pod_info,
+            str(fit_err),
+            "Unschedulable",
+            nominated_node,
+            pod_scheduling_cycle,
+        )
+
+    def attempt_schedule(self, pod_info: PodInfo) -> None:
+        """Scheduling cycle for one popped pod: the body of scheduleOne."""
         pod_scheduling_cycle = self.queue.scheduling_cycle
         pod = pod_info.pod
         try:
             prof = self.profile_for_pod(pod)
         except KeyError as e:
             logger.error("%s", e)
-            return True
+            return
         if self._skip_pod_schedule(pod):
-            return True
+            return
 
         state = CycleState()
-        start = time.perf_counter()
         try:
             result = self.algorithm.schedule(prof, state, pod)
         except FitError as fit_err:
-            nominated_node = ""
-            if self.preemptor is not None:
-                try:
-                    nominated_node = self.preemptor.preempt(
-                        prof, state, pod, fit_err
-                    )
-                except Exception:
-                    logger.exception("preemption for %s failed", pod.key())
-            self.record_scheduling_failure(
-                prof,
-                pod_info,
-                str(fit_err),
-                "Unschedulable",
-                nominated_node,
-                pod_scheduling_cycle,
+            self.handle_fit_error(
+                prof, state, pod_info, fit_err, pod_scheduling_cycle
             )
-            return True
+            return
         except Exception as e:
             logger.exception("scheduling %s failed", pod.key())
             self.record_scheduling_failure(
                 prof, pod_info, str(e), "SchedulerError", "", pod_scheduling_cycle
             )
-            return True
+            return
+        self.finish_schedule(
+            prof, state, pod_info, result.suggested_host, pod_scheduling_cycle
+        )
 
-        host = result.suggested_host
+    def finish_schedule(
+        self,
+        prof: Framework,
+        state: CycleState,
+        pod_info: PodInfo,
+        host: str,
+        pod_scheduling_cycle: int,
+    ) -> None:
+        """Post-decision pipeline (scheduler.go:615-738): Reserve ->
+        assume -> Permit -> async binding cycle. Shared by the sequential
+        path and the TPU batch solver (which replaces only the
+        filter/score/select stage)."""
+        pod = pod_info.pod
         assumed = pod.deepcopy()
 
         # Reserve
@@ -208,7 +242,7 @@ class Scheduler:
                 prof, pod_info, status.message(), "SchedulerError", "",
                 pod_scheduling_cycle,
             )
-            return True
+            return
 
         # Assume: the pod occupies the node in cache from here on.
         try:
@@ -218,7 +252,7 @@ class Scheduler:
             self.record_scheduling_failure(
                 prof, pod_info, str(e), "SchedulerError", "", pod_scheduling_cycle
             )
-            return True
+            return
 
         # Permit
         status = prof.run_permit_plugins(state, assumed, host)
@@ -235,7 +269,7 @@ class Scheduler:
             self.record_scheduling_failure(
                 prof, pod_info, status.message(), reason, "", pod_scheduling_cycle
             )
-            return True
+            return
 
         # Binding cycle: async goroutine in the reference (scheduler.go:666).
         if self._bind_pool is not None:
@@ -254,7 +288,7 @@ class Scheduler:
             self._binding_cycle(
                 prof, state, pod_info, assumed, host, pod_scheduling_cycle
             )
-        return True
+        return
 
     def _binding_cycle_safe(self, *args) -> None:
         try:
@@ -353,9 +387,13 @@ def new_scheduler(
     async_binding: bool = True,
     cache_ttl_seconds: float = 30.0,
     rng=None,
+    batch: bool = False,
+    max_batch: int = 256,
+    solver_config=None,
 ) -> Scheduler:
     """Build a fully wired scheduler (reference scheduler.go:223 New +
-    factory.go create)."""
+    factory.go create). ``batch=True`` selects the TPU batch-solver loop
+    (the out-of-tree ``tpu-jax`` profile of the north star)."""
     registry = new_in_tree_registry()
     registry.merge(out_of_tree_registry)
 
@@ -392,14 +430,29 @@ def new_scheduler(
     queue = PriorityQueue(first_fw.queue_sort_less_func())
     algorithm.nominated_pods_lister = queue
 
-    sched = Scheduler(
-        cache,
-        queue,
-        algorithm,
-        frameworks,
-        client=client,
-        async_binding=async_binding,
-    )
+    if batch:
+        from kubernetes_tpu.ops.assignment import GreedyConfig
+        from kubernetes_tpu.scheduler.batch import BatchScheduler
+
+        sched: Scheduler = BatchScheduler(
+            cache,
+            queue,
+            algorithm,
+            frameworks,
+            client=client,
+            async_binding=async_binding,
+            max_batch=max_batch,
+            solver_config=solver_config or GreedyConfig(),
+        )
+    else:
+        sched = Scheduler(
+            cache,
+            queue,
+            algorithm,
+            frameworks,
+            client=client,
+            async_binding=async_binding,
+        )
     from kubernetes_tpu.scheduler.eventhandlers import add_all_event_handlers
 
     add_all_event_handlers(sched, informer_factory)

@@ -1,0 +1,320 @@
+"""NodeTensor: the ``[N, R]`` packed cluster state + incremental updates.
+
+This lifts the reference's NodeInfo aggregates
+(/root/reference/pkg/scheduler/nodeinfo/node_info.go:47: allocatable,
+requestedResource, nonzeroRequest) into dense int32 device-ready arrays,
+and mirrors the generation-based incremental snapshot update
+(internal/cache/cache.go:203 UpdateSnapshot: only changed nodes are
+copied) as an incremental row repack.
+
+Units (chosen so int32 masks are EXACT, matching the reference's integer
+quantity comparisons; see Fit semantics fit.go:181-252):
+  col 0: cpu          milliCPU
+  col 1: memory       KiB (allocatable floored, requests ceiled --
+                      conservative: never admits a pod the byte-exact
+                      check would reject)
+  col 2: ephemeral    KiB (same rounding)
+  col 3: pods         pod count / allowed pod number
+  col 4+: extended/scalar resources, whole units, in ``ResourceDims`` order
+
+Capacity is padded to the next multiple of 128 (TPU lane width) so the
+solver JITs once per bucket, not per node-count (SURVEY.md section 7
+"hardest parts (b)": pad to buckets, mask).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api.types import (
+    Pod,
+    RESOURCE_CPU,
+    RESOURCE_EPHEMERAL_STORAGE,
+    RESOURCE_MEMORY,
+    RESOURCE_PODS,
+    ResourceList,
+    pod_resource_requests,
+)
+from kubernetes_tpu.cache.node_info import (
+    NodeInfo,
+    Resource,
+    non_zero_requests,
+)
+from kubernetes_tpu.cache.snapshot import Snapshot
+from kubernetes_tpu.tensors.encoding import TopologyEncoder
+
+NODE_BUCKET = 128  # row padding granularity (TPU lane width)
+
+CPU, MEM, EPH, PODS = 0, 1, 2, 3
+NUM_FIXED_DIMS = 4
+
+
+def _kib_floor(b: int) -> int:
+    return b // 1024
+
+
+def _kib_ceil(b: int) -> int:
+    return -((-b) // 1024)
+
+
+class ResourceDims:
+    """Resource name -> tensor column. Fixed dims 0-3; scalar/extended
+    resources get columns as they first appear. Growing the dim set bumps
+    ``version`` which invalidates packed tensors."""
+
+    def __init__(self) -> None:
+        self._scalar_cols: Dict[str, int] = {}
+        self.version = 0
+
+    @property
+    def num_dims(self) -> int:
+        return NUM_FIXED_DIMS + len(self._scalar_cols)
+
+    def scalar_names(self) -> List[str]:
+        return sorted(self._scalar_cols, key=self._scalar_cols.__getitem__)
+
+    def column(self, resource: str) -> int:
+        if resource == RESOURCE_CPU:
+            return CPU
+        if resource == RESOURCE_MEMORY:
+            return MEM
+        if resource == RESOURCE_EPHEMERAL_STORAGE:
+            return EPH
+        if resource == RESOURCE_PODS:
+            return PODS
+        col = self._scalar_cols.get(resource)
+        if col is None:
+            col = NUM_FIXED_DIMS + len(self._scalar_cols)
+            self._scalar_cols[resource] = col
+            self.version += 1
+        return col
+
+    def encode_resource(self, r: Resource, *, ceil_bytes: bool) -> np.ndarray:
+        kib = _kib_ceil if ceil_bytes else _kib_floor
+        row = np.zeros(self.num_dims, dtype=np.int32)
+        row[CPU] = r.milli_cpu
+        row[MEM] = kib(r.memory)
+        row[EPH] = kib(r.ephemeral_storage)
+        row[PODS] = r.allowed_pod_number
+        for name, qty in r.scalar.items():
+            row[self.column(name)] = qty
+        return row
+
+    def encode_requests(
+        self, rl: ResourceList, *, ceil_bytes: bool = True, grow: bool = True
+    ) -> Tuple[np.ndarray, bool]:
+        """Returns (row, unknown): ``unknown`` is True when ``grow=False``
+        and the list names a scalar resource with no column -- i.e. a
+        resource no node in the cluster advertises, so the request is
+        unsatisfiable by definition (fit.go: allocatable 0 < request)."""
+        kib = _kib_ceil if ceil_bytes else _kib_floor
+        row = np.zeros(self.num_dims, dtype=np.int32)
+        unknown = False
+        for name, qty in rl.items():
+            if name == RESOURCE_CPU:
+                row[CPU] = qty
+            elif name == RESOURCE_MEMORY:
+                row[MEM] = kib(qty)
+            elif name == RESOURCE_EPHEMERAL_STORAGE:
+                row[EPH] = kib(qty)
+            elif name == RESOURCE_PODS:
+                row[PODS] = qty
+            elif not grow and name not in self._scalar_cols:
+                if qty > 0:
+                    unknown = True
+            else:
+                row[self.column(name)] = qty
+        return row, unknown
+
+
+@dataclass
+class NodeTensor:
+    """The packed view handed to the solver. Rows [num_nodes:] are padding
+    (allocatable all-zero => infeasible for any non-zero request; the
+    ``valid`` mask guards zero-request pods)."""
+
+    names: List[str]
+    allocatable: np.ndarray  # [N, R] int32
+    requested: np.ndarray  # [N, R] int32 (col PODS = current pod count)
+    non_zero_requested: np.ndarray  # [N, 2] int32 (milliCPU, KiB)
+    valid: np.ndarray  # [N] bool
+    topology: np.ndarray  # [N, K] int32 interned topology values
+    dims: ResourceDims
+    topology_encoder: TopologyEncoder
+    _row_of: Optional[Dict[str, int]] = field(default=None, repr=False)
+
+    @property
+    def capacity(self) -> int:
+        return self.allocatable.shape[0]
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.names)
+
+    def row(self, name: str) -> int:
+        if self._row_of is None:
+            self._row_of = {n: i for i, n in enumerate(self.names)}
+        return self._row_of[name]
+
+
+class NodeTensorCache:
+    """Incremental Snapshot -> NodeTensor packer.
+
+    Mirrors cache.UpdateSnapshot's generation compare (cache.go:239): a row
+    is repacked only when its NodeInfo.generation moved. Node add/remove
+    and resource/topology schema growth trigger a full repack."""
+
+    def __init__(
+        self,
+        dims: Optional[ResourceDims] = None,
+        topology_encoder: Optional[TopologyEncoder] = None,
+    ) -> None:
+        self.dims = dims or ResourceDims()
+        self.topology = topology_encoder or TopologyEncoder()
+        self._row_of: Dict[str, int] = {}
+        self._generations: List[int] = []
+        self._names: List[str] = []
+        self._alloc = np.zeros((0, self.dims.num_dims), dtype=np.int32)
+        self._req = np.zeros((0, self.dims.num_dims), dtype=np.int32)
+        self._nzr = np.zeros((0, 2), dtype=np.int32)
+        self._topo = np.zeros((0, 0), dtype=np.int32)
+        self._dims_version = self.dims.version
+        self._topo_version = self.topology.version
+        self.full_repacks = 0
+        self.rows_repacked = 0
+
+    # -- packing one node ---------------------------------------------------
+
+    def _pack_row(self, i: int, ni: NodeInfo) -> None:
+        self._alloc[i] = self.dims.encode_resource(ni.allocatable, ceil_bytes=False)
+        req = self.dims.encode_resource(ni.requested, ceil_bytes=True)
+        req[PODS] = len(ni.pods)
+        self._req[i] = req
+        self._nzr[i, 0] = ni.non_zero_requested.milli_cpu
+        self._nzr[i, 1] = _kib_ceil(ni.non_zero_requested.memory)
+        if self.topology.keys:
+            self._topo[i] = self.topology.encode_node_labels(
+                ni.node.metadata.labels if ni.node else {}
+            )
+        self._generations[i] = ni.generation
+
+    def _grow(self, n: int) -> None:
+        cap = max(NODE_BUCKET, NODE_BUCKET * math.ceil(n / NODE_BUCKET))
+        r = self.dims.num_dims
+        k = len(self.topology.keys)
+        self._alloc = np.zeros((cap, r), dtype=np.int32)
+        self._req = np.zeros((cap, r), dtype=np.int32)
+        self._nzr = np.zeros((cap, 2), dtype=np.int32)
+        self._topo = np.zeros((cap, k), dtype=np.int32)
+
+    # -- the update entry point --------------------------------------------
+
+    def update(self, snapshot: Snapshot) -> NodeTensor:
+        infos = snapshot.list_node_infos()
+        names = [ni.node_name for ni in infos]
+        # Register scalar-resource columns BEFORE sizing arrays: packing a
+        # row must never grow the schema mid-update.
+        for ni in infos:
+            for name in ni.allocatable.scalar:
+                self.dims.column(name)
+            for name in ni.requested.scalar:
+                self.dims.column(name)
+        schema_moved = (
+            self.dims.version != self._dims_version
+            or self.topology.version != self._topo_version
+        )
+        membership_moved = names != self._names
+        if schema_moved or membership_moved or self._alloc.shape[0] < len(infos):
+            # full repack (node set or schema changed)
+            self._names = list(names)
+            self._row_of = {n: i for i, n in enumerate(names)}
+            self._generations = [0] * len(infos)
+            self._grow(len(infos))
+            for i, ni in enumerate(infos):
+                self._pack_row(i, ni)
+            self.full_repacks += 1
+            self.rows_repacked += len(infos)
+        else:
+            for i, ni in enumerate(infos):
+                if self._generations[i] != ni.generation:
+                    self._pack_row(i, ni)
+                    self.rows_repacked += 1
+        self._dims_version = self.dims.version
+        self._topo_version = self.topology.version
+
+        valid = np.zeros(self._alloc.shape[0], dtype=bool)
+        valid[: len(infos)] = True
+        return NodeTensor(
+            names=self._names,
+            allocatable=self._alloc,
+            requested=self._req,
+            non_zero_requested=self._nzr,
+            valid=valid,
+            topology=self._topo,
+            dims=self.dims,
+            topology_encoder=self.topology,
+        )
+
+
+@dataclass
+class PodBatch:
+    """A batch of pending pods packed for the solver."""
+
+    pods: List[Pod]
+    requests: np.ndarray  # [B, R] int32 (col PODS == 1)
+    non_zero_requests: np.ndarray  # [B, 2] int32
+    priorities: np.ndarray  # [B] int32
+    order: np.ndarray  # [B] int32: solve order (priority desc, FIFO)
+    unsatisfiable: np.ndarray  # [B] bool: requests a resource no node has
+
+    @property
+    def size(self) -> int:
+        return len(self.pods)
+
+
+def pack_pod_batch(
+    pods: List[Pod],
+    dims: ResourceDims,
+    timestamps: Optional[List[float]] = None,
+) -> PodBatch:
+    """Pack pending pods into a batch. Solve order matches the activeQ
+    comparator (queuesort/priority_sort.go: priority desc, then enqueue
+    time) so batched greedy assignment replays the sequential order.
+
+    The schema is frozen here (``grow=False``): a pod requesting a scalar
+    resource no node advertises is flagged ``unsatisfiable`` instead of
+    growing the dim set mid-batch (which would shape-mismatch the
+    already-packed node tensor)."""
+    b = len(pods)
+    requests = np.zeros((b, dims.num_dims), dtype=np.int32)
+    nzr = np.zeros((b, 2), dtype=np.int32)
+    priorities = np.zeros(b, dtype=np.int32)
+    unsatisfiable = np.zeros(b, dtype=bool)
+    for i, pod in enumerate(pods):
+        row, unknown = dims.encode_requests(
+            pod_resource_requests(pod), grow=False
+        )
+        row[PODS] = 1
+        requests[i] = row
+        unsatisfiable[i] = unknown
+        cpu, mem = non_zero_requests(pod)
+        nzr[i, 0] = cpu
+        nzr[i, 1] = _kib_ceil(mem)
+        priorities[i] = pod.spec.priority
+    ts = timestamps or [pod.metadata.creation_timestamp for pod in pods]
+    order = np.array(
+        sorted(range(b), key=lambda i: (-int(priorities[i]), ts[i])),
+        dtype=np.int32,
+    )
+    return PodBatch(
+        pods=list(pods),
+        requests=requests,
+        non_zero_requests=nzr,
+        priorities=priorities,
+        order=order,
+        unsatisfiable=unsatisfiable,
+    )

@@ -2,8 +2,10 @@
 
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
 exercised without TPU hardware (the driver separately dry-runs the
-multi-chip path via __graft_entry__.dryrun_multichip). These env vars must
-be set before jax initializes its backends, hence at conftest import time.
+multi-chip path via __graft_entry__.dryrun_multichip). The env vars must
+be set before jax initializes its backends; additionally the installed
+axon TPU plugin force-prepends itself to jax_platforms regardless of
+JAX_PLATFORMS, so the config is also pinned programmatically.
 """
 
 import os
@@ -14,3 +16,7 @@ if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (
         prev + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,241 @@
+"""BatchScheduler end-to-end tests: device-solved placement through the
+full apiserver/informer/bind pipeline, plus fallback routing."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.scheduler.batch import solver_supported
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _wait_all_bound(client, count, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        pods, _ = client.list_pods()
+        bound = [p for p in pods if p.spec.node_name]
+        if len(bound) >= count:
+            return pods
+        time.sleep(0.05)
+    raise AssertionError(
+        f"only {len([p for p in client.list_pods()[0] if p.spec.node_name])}"
+        f"/{count} pods bound"
+    )
+
+
+@pytest.fixture
+def cluster():
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(client, informers, batch=True, max_batch=64)
+    yield server, client, informers, sched
+    sched.stop()
+    informers.stop()
+
+
+class TestBatchScheduling:
+    def test_burst_scheduled_on_device(self, cluster):
+        server, client, informers, sched = cluster
+        for i in range(8):
+            client.create_node(
+                make_node(f"n{i}").capacity(cpu="8", memory="16Gi", pods=30).obj()
+            )
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        for i in range(40):
+            client.create_pod(
+                make_pod(f"p{i}").container(cpu="250m", memory="256Mi").obj()
+            )
+        t = sched.start()
+        pods = _wait_all_bound(client, 40)
+        sched.wait_for_inflight_binds()
+        assert sched.pods_solved_on_device >= 40
+        assert sched.pods_fallback == 0
+        # capacity respected on every node
+        per_node = {}
+        for p in pods:
+            per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+        assert all(v <= 30 for v in per_node.values())
+
+    def test_infeasible_pod_recorded_unschedulable(self, cluster):
+        server, client, informers, sched = cluster
+        client.create_node(make_node("n").capacity(cpu="1", memory="1Gi").obj())
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        client.create_pod(make_pod("big").container(cpu="64", memory="1Ti").obj())
+        client.create_pod(make_pod("ok").container(cpu="500m").obj())
+        sched.start()
+        _wait_all_bound(client, 1)
+        sched.wait_for_inflight_binds()
+        deadline = time.time() + 5
+        big = None
+        while time.time() < deadline:
+            big = client.get_pod("default", "big")
+            if any(c.type == "PodScheduled" and c.status == "False"
+                   for c in big.status.conditions):
+                break
+            time.sleep(0.05)
+        assert big is not None
+        assert not big.spec.node_name
+        assert any(
+            c.type == "PodScheduled" and c.status == "False" and
+            c.reason == "Unschedulable"
+            for c in big.status.conditions
+        )
+
+    def test_fallback_pods_routed_to_sequential_path(self, cluster):
+        server, client, informers, sched = cluster
+        for name, zone in [("a", "z1"), ("b", "z2")]:
+            client.create_node(
+                make_node(name).labels(zone=zone)
+                .capacity(cpu="8", memory="16Gi", pods=20).obj()
+            )
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        # spread-constrained pods are not solver_supported -> fallback
+        for i in range(4):
+            client.create_pod(
+                make_pod(f"s{i}").labels(app="s")
+                .container(cpu="100m")
+                .spread_constraint(1, "zone", match_labels={"app": "s"})
+                .obj()
+            )
+        for i in range(4):
+            client.create_pod(make_pod(f"r{i}").container(cpu="100m").obj())
+        sched.start()
+        pods = _wait_all_bound(client, 8)
+        sched.wait_for_inflight_binds()
+        assert sched.pods_fallback >= 4
+        assert sched.pods_solved_on_device >= 4
+        zones = {"z1": 0, "z2": 0}
+        for p in pods:
+            if p.name.startswith("s"):
+                zones["z1" if p.spec.node_name == "a" else "z2"] += 1
+        assert abs(zones["z1"] - zones["z2"]) <= 1
+
+    def test_node_selector_respected_via_static_mask(self, cluster):
+        server, client, informers, sched = cluster
+        client.create_node(
+            make_node("gpu").labels(pool="gpu")
+            .capacity(cpu="8", memory="16Gi").obj()
+        )
+        client.create_node(
+            make_node("cpu").labels(pool="cpu")
+            .capacity(cpu="64", memory="128Gi").obj()
+        )
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        for i in range(3):
+            client.create_pod(
+                make_pod(f"g{i}").container(cpu="1")
+                .node_selector(pool="gpu").obj()
+            )
+        sched.start()
+        pods = _wait_all_bound(client, 3)
+        for p in pods:
+            assert p.spec.node_name == "gpu"
+
+    def test_tainted_node_avoided(self, cluster):
+        server, client, informers, sched = cluster
+        client.create_node(
+            make_node("t").taint("dedicated", "infra")
+            .capacity(cpu="64", memory="64Gi").obj()
+        )
+        client.create_node(make_node("ok").capacity(cpu="2", memory="4Gi").obj())
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        for i in range(3):
+            client.create_pod(make_pod(f"p{i}").container(cpu="100m").obj())
+        client.create_pod(
+            make_pod("tolerant").container(cpu="100m")
+            .toleration("dedicated", value="infra").obj()
+        )
+        sched.start()
+        pods = _wait_all_bound(client, 4)
+        for p in pods:
+            if p.name == "tolerant":
+                continue
+            assert p.spec.node_name == "ok"
+
+
+class TestRegressions:
+    def test_unknown_extended_resource_is_unschedulable_not_crash(self, cluster):
+        server, client, informers, sched = cluster
+        client.create_node(make_node("n").capacity(cpu="8", memory="16Gi").obj())
+        informers.start()
+        informers.wait_for_cache_sync()
+        client.create_pod(
+            make_pod("gpu").container(cpu="1", **{"example_com__gpu": 2}).obj()
+        )
+        client.create_pod(make_pod("ok").container(cpu="1").obj())
+        sched.start()
+        _wait_all_bound(client, 1)
+        sched.wait_for_inflight_binds()
+        gpu = client.get_pod("default", "gpu")
+        assert not gpu.spec.node_name
+        ok = client.get_pod("default", "ok")
+        assert ok.spec.node_name == "n"
+
+    def test_tolerate_everything_admits_cordoned_node(self, cluster):
+        server, client, informers, sched = cluster
+        node = make_node("c").capacity(cpu="8", memory="16Gi").unschedulable().obj()
+        client.create_node(node)
+        informers.start()
+        informers.wait_for_cache_sync()
+        p = make_pod("t").container(cpu="1").obj()
+        from kubernetes_tpu.api.types import Toleration
+        p.spec.tolerations.append(Toleration(key="", operator="Exists"))
+        client.create_pod(p)
+        sched.start()
+        pods = _wait_all_bound(client, 1)
+        assert pods[0].spec.node_name == "c"
+
+    def test_fallback_does_not_jump_high_priority_solver_pod(self, cluster):
+        server, client, informers, sched = cluster
+        client.create_node(make_node("n").capacity(cpu="1", memory="4Gi").obj())
+        informers.start()
+        informers.wait_for_cache_sync()
+        # high-priority plain pod and low-priority spread pod compete for
+        # the single cpu; queue order must win
+        high = make_pod("high").container(cpu="1").obj()
+        high.spec.priority = 100
+        low = (
+            make_pod("low").labels(app="low").container(cpu="1")
+            .spread_constraint(1, "zone", match_labels={"app": "low"})
+            .obj()
+        )
+        client.create_pod(high)
+        client.create_pod(low)
+        sched.start()
+        _wait_all_bound(client, 1)
+        sched.wait_for_inflight_binds()
+        assert client.get_pod("default", "high").spec.node_name == "n"
+        assert not client.get_pod("default", "low").spec.node_name
+
+
+class TestSolverSupported:
+    def test_plain_pod(self):
+        assert solver_supported(make_pod("p").container(cpu="1").obj())
+
+    def test_affinity_not_supported(self):
+        assert not solver_supported(
+            make_pod("p").pod_affinity("zone", {"a": "b"}).obj()
+        )
+
+    def test_spread_not_supported(self):
+        assert not solver_supported(
+            make_pod("p").spread_constraint(1, "zone").obj()
+        )
+
+    def test_node_selector_supported(self):
+        assert solver_supported(make_pod("p").node_selector(pool="x").obj())

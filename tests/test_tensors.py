@@ -1,0 +1,120 @@
+"""NodeTensor packing + incremental update tests (the tensor analogue of
+the reference's cache_test.go UpdateSnapshot cases)."""
+
+import numpy as np
+
+from kubernetes_tpu.cache.cache import SchedulerCache
+from kubernetes_tpu.cache.snapshot import Snapshot, new_snapshot
+from kubernetes_tpu.tensors import (
+    NodeTensorCache,
+    ResourceDims,
+    pack_pod_batch,
+)
+from kubernetes_tpu.tensors.node_tensor import CPU, MEM, PODS
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def test_pack_basic_resources():
+    snap = new_snapshot(
+        [make_pod("p").node("n1").container(cpu="500m", memory="1Gi").obj()],
+        [make_node("n1").capacity(cpu="4", memory="8Gi", pods=10).obj()],
+    )
+    nt = NodeTensorCache().update(snap)
+    assert nt.num_nodes == 1
+    assert nt.capacity == 128  # padded to bucket
+    i = nt.row("n1")
+    assert nt.allocatable[i, CPU] == 4000
+    assert nt.allocatable[i, MEM] == 8 * 1024 * 1024  # KiB
+    assert nt.allocatable[i, PODS] == 10
+    assert nt.requested[i, CPU] == 500
+    assert nt.requested[i, MEM] == 1024 * 1024
+    assert nt.requested[i, PODS] == 1
+    assert nt.valid[i]
+    assert not nt.valid[1]
+
+
+def test_scalar_resources_get_columns():
+    dims = ResourceDims()
+    snap = new_snapshot(
+        [],
+        [
+            make_node("g")
+            .capacity(cpu="8", memory="16Gi", pods=10, **{"nvidia.com/gpu": 4})
+            .obj()
+        ],
+    )
+    nt = NodeTensorCache(dims).update(snap)
+    col = dims.column("nvidia.com/gpu")
+    # column registered after first pack -> full repack next update
+    nt = NodeTensorCache(dims).update(snap)
+    assert nt.allocatable[nt.row("g"), col] == 4
+
+
+def test_incremental_update_only_changed_rows():
+    cache = SchedulerCache()
+    for i in range(5):
+        cache.add_node(make_node(f"n{i}").capacity(cpu="4", memory="8Gi").obj())
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    tc = NodeTensorCache()
+    tc.update(snap)
+    assert tc.full_repacks == 1
+    repacked_before = tc.rows_repacked
+
+    pod = make_pod("p").node("n2").container(cpu="1").obj()
+    cache.add_pod(pod)
+    cache.update_snapshot(snap)
+    nt = tc.update(snap)
+    assert tc.full_repacks == 1  # no membership change
+    assert tc.rows_repacked == repacked_before + 1  # only n2 repacked
+    assert nt.requested[nt.row("n2"), CPU] == 1000
+
+    # node add => full repack
+    cache.add_node(make_node("n9").capacity(cpu="2", memory="2Gi").obj())
+    cache.update_snapshot(snap)
+    nt = tc.update(snap)
+    assert tc.full_repacks == 2
+    assert "n9" in nt.names
+
+
+def test_topology_encoding():
+    tc = NodeTensorCache()
+    tc.topology.register_key("zone")
+    snap = new_snapshot(
+        [],
+        [
+            make_node("a").labels(zone="z1").obj(),
+            make_node("b").labels(zone="z2").obj(),
+            make_node("c").obj(),  # no zone
+        ],
+    )
+    nt = tc.update(snap)
+    za = nt.topology[nt.row("a"), 0]
+    zb = nt.topology[nt.row("b"), 0]
+    zc = nt.topology[nt.row("c"), 0]
+    assert za != zb and za != 0 and zb != 0
+    assert zc == 0  # ABSENT
+
+
+def test_pod_batch_order_priority_then_fifo():
+    pods = [
+        make_pod("low").creation_timestamp(1.0).obj(),
+        make_pod("high").creation_timestamp(2.0).obj(),
+        make_pod("mid-late").creation_timestamp(3.0).obj(),
+        make_pod("mid-early").creation_timestamp(2.5).obj(),
+    ]
+    pods[0].spec.priority = 0
+    pods[1].spec.priority = 10
+    pods[2].spec.priority = 5
+    pods[3].spec.priority = 5
+    batch = pack_pod_batch(pods, ResourceDims())
+    names = [batch.pods[i].name for i in batch.order]
+    assert names == ["high", "mid-early", "mid-late", "low"]
+
+
+def test_non_zero_defaults_in_batch():
+    batch = pack_pod_batch([make_pod("empty").container().obj()], ResourceDims())
+    # util/non_zero.go defaults: 100m / 200Mi
+    assert batch.non_zero_requests[0, 0] == 100
+    assert batch.non_zero_requests[0, 1] == 200 * 1024
+    assert batch.requests[0, PODS] == 1
