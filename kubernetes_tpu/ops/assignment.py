@@ -44,9 +44,13 @@ NO_NODE = -1
 
 @dataclass(frozen=True)
 class GreedyConfig:
-    """Score-plugin weights (mirrors the default provider's Score list,
-    algorithmprovider/registry.go:118: LeastAllocated w1 +
-    BalancedAllocation w1; MostAllocated for bin-packing profiles)."""
+    """Device score-plugin weights: the resource scorers only
+    (LeastAllocated/BalancedAllocation at the default provider's weight 1,
+    MostAllocated for bin-packing profiles). Label-dependent soft scorers
+    (ImageLocality, preferred NodeAffinity, TaintToleration
+    PreferNoSchedule, ...) are not yet on device, so batch-path rankings
+    can differ from the sequential path by those terms; hard constraints
+    are protected by the static mask + cluster_solver_compatible gate."""
 
     least_allocated_weight: int = 1
     balanced_allocation_weight: int = 1

@@ -26,8 +26,10 @@ def _fractions(
     caps: jnp.ndarray,  # [N, 2] int32 (milliCPU, memKiB)
     nzr: jnp.ndarray,  # [N, 2] int32
     pod_nzr: jnp.ndarray,  # [B, 2] int32
-) -> jnp.ndarray:
-    """[B, N, 2] float32 requested/capacity fractions (inf-safe)."""
+):
+    """Returns (req [B, N, 2], cap [1, N, 2]) float32: the summed
+    requested magnitudes (node total + incoming pod) and broadcastable
+    capacities. Division happens in each scorer."""
     req = nzr[None, :, :] + pod_nzr[:, None, :]
     cap = caps[None, :, :].astype(jnp.float32)
     return req.astype(jnp.float32), cap

@@ -58,6 +58,41 @@ def solver_supported(pod: Pod) -> bool:
     return True
 
 
+_AVOID_PODS_ANNOTATION = "scheduler.alpha.kubernetes.io/preferAvoidPods"
+
+
+def cluster_solver_compatible(snapshot) -> bool:
+    """Cluster-level conditions the device solver can't express yet.
+
+    (1) Existing pods with REQUIRED anti-affinity impose symmetric hard
+    constraints on incoming pods that have no affinity of their own
+    (interpodaffinity filtering.go:404 satisfiesExistingPodsAntiAffinity);
+    the static mask doesn't model them, so their presence forces the
+    sequential path. Preferred-only (anti-)affinity on existing pods is a
+    score divergence, not a correctness one, and does not disable batching.
+
+    (2) The preferAvoidPods annotation scores at weight 10000 -- a
+    near-hard exclusion sequentially -- which the device scorer set
+    doesn't include.
+    """
+    for ni in snapshot.have_pods_with_affinity_list:
+        for p in ni.pods_with_affinity:
+            a = p.spec.affinity
+            if (
+                a is not None
+                and a.pod_anti_affinity is not None
+                and a.pod_anti_affinity.required_during_scheduling
+            ):
+                return False
+    for ni in snapshot.list_node_infos():
+        if (
+            ni.node is not None
+            and _AVOID_PODS_ANNOTATION in ni.node.metadata.annotations
+        ):
+            return False
+    return True
+
+
 class BatchScheduler(Scheduler):
     def __init__(
         self,
@@ -86,6 +121,10 @@ class BatchScheduler(Scheduler):
             return 0
         pod_scheduling_cycle = self.queue.scheduling_cycle
 
+        snapshot = self.algorithm.snapshot
+        self.cache.update_snapshot(snapshot)
+        device_ok = cluster_solver_compatible(snapshot)
+
         # Process in activeQ order: a fallback pod must not jump ahead of
         # higher-priority solver pods popped before it, so solver runs are
         # flushed at each fallback boundary (each flush re-snapshots, so
@@ -101,7 +140,7 @@ class BatchScheduler(Scheduler):
         for pi in batch_infos:
             if self._skip_pod_schedule(pi.pod):
                 continue
-            if solver_supported(pi.pod):
+            if device_ok and solver_supported(pi.pod):
                 solver_infos.append(pi)
             else:
                 flush()
@@ -124,6 +163,28 @@ class BatchScheduler(Scheduler):
         # pods requesting resources no node advertises are unsatisfiable
         smask[batch.unsatisfiable] = False
 
+        # Nominated-pod overlay: reserve capacity for preemption nominees
+        # (the batch analogue of _add_nominated_pods' virtual add,
+        # generic_scheduler.go:535). Conservatively reserves for ALL
+        # nominees regardless of relative priority.
+        node_requested, node_nzr = nt.requested, nt.non_zero_requested
+        batch_uids = {pi.pod.metadata.uid for pi in solver_infos}
+        copied = False
+        for node_name, nominated in self.queue.nominated_pods.nominated_pods.items():
+            if not node_name or node_name not in nt.names:
+                continue
+            j = nt.row(node_name)
+            for npod in nominated:
+                if npod.metadata.uid in batch_uids:
+                    continue
+                if not copied:
+                    node_requested = node_requested.copy()
+                    node_nzr = node_nzr.copy()
+                    copied = True
+                nbatch = pack_pod_batch([npod], nt.dims)
+                node_requested[j] += nbatch.requests[0]
+                node_nzr[j] += nbatch.non_zero_requests[0]
+
         b = batch.size
         padded = POD_BUCKET * math.ceil(b / POD_BUCKET)
         order = batch.order
@@ -138,8 +199,8 @@ class BatchScheduler(Scheduler):
 
         assignments, _, _ = greedy_assign(
             jnp.asarray(nt.allocatable),
-            jnp.asarray(nt.requested),
-            jnp.asarray(nt.non_zero_requested),
+            jnp.asarray(node_requested),
+            jnp.asarray(node_nzr),
             jnp.asarray(nt.valid),
             jnp.asarray(req),
             jnp.asarray(nzr),
@@ -160,6 +221,10 @@ class BatchScheduler(Scheduler):
             state = CycleState()
             state.write(SNAPSHOT_STATE_KEY, snapshot)
             if choice == NO_NODE:
+                # populate PreFilter state so preemption's victim
+                # simulation can run the full filter pipeline (the
+                # sequential path gets this from algorithm.schedule)
+                prof.run_pre_filter_plugins(state, pi.pod)
                 fit_err = FitError(pi.pod, num_nodes, {})
                 self.handle_fit_error(
                     prof, state, pi, fit_err, pod_scheduling_cycle

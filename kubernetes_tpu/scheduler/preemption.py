@@ -1,0 +1,329 @@
+"""Preemption: evict lower-priority pods to make room for a pending pod.
+
+Reference: /root/reference/pkg/scheduler/core/generic_scheduler.go
+(Preempt :270, selectNodesForPreemption :850, selectVictimsOnNode :940,
+filterPodsWithPDBViolation :884, pickOneNodeForPreemption :721,
+nodesWherePreemptionMightHelp :1033, podEligibleToPreemptOthers :1054)
+and pkg/scheduler/scheduler.go:392 (sched.preempt host-side actions), with
+MoreImportantPod/GetPodStartTime from pkg/scheduler/util/utils.go:38-83.
+
+The TPU-vectorized victim search (sorted victim prefix + re-mask check per
+candidate node) plugs in at ``select_victims_on_node``; this host
+implementation is the parity oracle.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api.selectors import labels_match_selector
+from kubernetes_tpu.api.types import Pod, PodDisruptionBudget
+from kubernetes_tpu.cache.node_info import NodeInfo
+from kubernetes_tpu.framework.interface import (
+    CycleState,
+    FitError,
+    StatusCode,
+)
+
+logger = logging.getLogger(__name__)
+
+_MAX_INT32 = (1 << 31) - 1
+
+
+def pod_start_time(pod: Pod) -> float:
+    """utils.go:38 GetPodStartTime: assumed/bound-but-unstarted pods count
+    as 'now'."""
+    if pod.status.start_time is not None:
+        return pod.status.start_time
+    return time.time()
+
+
+def more_important_pod(p1: Pod, p2: Pod) -> bool:
+    """utils.go:76: higher priority, then earlier start time."""
+    if p1.spec.priority != p2.spec.priority:
+        return p1.spec.priority > p2.spec.priority
+    return pod_start_time(p1) < pod_start_time(p2)
+
+
+def filter_pods_with_pdb_violation(
+    pods: List[Pod], pdbs: List[PodDisruptionBudget]
+) -> Tuple[List[Pod], List[Pod]]:
+    """generic_scheduler.go:884: greedily spend each PDB's
+    DisruptionsAllowed budget; pods beyond it are 'violating'."""
+    allowed = [pdb.status.disruptions_allowed for pdb in pdbs]
+    violating: List[Pod] = []
+    non_violating: List[Pod] = []
+    for pod in pods:
+        violated = False
+        if pod.metadata.labels:
+            for i, pdb in enumerate(pdbs):
+                if pdb.metadata.namespace != pod.metadata.namespace:
+                    continue
+                if pdb.selector is None:
+                    continue  # nil selector matches nothing
+                if not labels_match_selector(pod.metadata.labels, pdb.selector):
+                    continue
+                if allowed[i] <= 0:
+                    violated = True
+                    break
+                allowed[i] -= 1
+        (violating if violated else non_violating).append(pod)
+    return violating, non_violating
+
+
+class Victims:
+    __slots__ = ("pods", "num_pdb_violations")
+
+    def __init__(self, pods: List[Pod], num_pdb_violations: int) -> None:
+        self.pods = pods
+        self.num_pdb_violations = num_pdb_violations
+
+
+def pick_one_node_for_preemption(
+    nodes_to_victims: Dict[str, Victims]
+) -> Optional[str]:
+    """generic_scheduler.go:721: 6-rule lexicographic choice."""
+    if not nodes_to_victims:
+        return None
+    for name, victims in nodes_to_victims.items():
+        if not victims.pods:
+            return name  # free lunch: no preemption needed
+
+    candidates = list(nodes_to_victims)
+    # 1. fewest PDB violations
+    min_v = min(nodes_to_victims[n].num_pdb_violations for n in candidates)
+    candidates = [
+        n for n in candidates if nodes_to_victims[n].num_pdb_violations == min_v
+    ]
+    if len(candidates) == 1:
+        return candidates[0]
+    # 2. lowest highest-victim priority (victims sorted important-first)
+    min_hp = min(nodes_to_victims[n].pods[0].spec.priority for n in candidates)
+    candidates = [
+        n for n in candidates
+        if nodes_to_victims[n].pods[0].spec.priority == min_hp
+    ]
+    if len(candidates) == 1:
+        return candidates[0]
+    # 3. smallest priority sum (offset keeps negatives comparable)
+    def prio_sum(n: str) -> int:
+        return sum(
+            p.spec.priority + _MAX_INT32 + 1 for p in nodes_to_victims[n].pods
+        )
+
+    min_sum = min(prio_sum(n) for n in candidates)
+    candidates = [n for n in candidates if prio_sum(n) == min_sum]
+    if len(candidates) == 1:
+        return candidates[0]
+    # 4. fewest victims
+    min_pods = min(len(nodes_to_victims[n].pods) for n in candidates)
+    candidates = [
+        n for n in candidates if len(nodes_to_victims[n].pods) == min_pods
+    ]
+    if len(candidates) == 1:
+        return candidates[0]
+    # 5. latest earliest-start-time among highest-priority victims
+    def earliest_start(n: str) -> float:
+        pods = nodes_to_victims[n].pods
+        max_prio = pods[0].spec.priority
+        return min(
+            pod_start_time(p) for p in pods if p.spec.priority == max_prio
+        )
+
+    return max(candidates, key=earliest_start)
+
+
+class Preemptor:
+    """Wires the preemption algorithm to the API side effects
+    (scheduler.go:392 preempt + podPreemptor)."""
+
+    def __init__(self, algorithm, queue, client) -> None:
+        self.algorithm = algorithm  # GenericScheduler (snapshot + filters)
+        self.queue = queue
+        self.client = client
+
+    # -- eligibility --------------------------------------------------------
+
+    def pod_eligible_to_preempt_others(self, pod: Pod) -> bool:
+        """generic_scheduler.go:1054."""
+        if pod.spec.preemption_policy == "Never":
+            return False
+        nom = pod.status.nominated_node_name
+        if nom:
+            ni = self.algorithm.snapshot.get_node_info(nom)
+            if ni is not None:
+                for p in ni.pods:
+                    if (
+                        p.metadata.deletion_timestamp is not None
+                        and p.spec.priority < pod.spec.priority
+                    ):
+                        return False  # a previous victim is still terminating
+        return True
+
+    # -- core algorithm -----------------------------------------------------
+
+    def nodes_where_preemption_might_help(
+        self, fit_err: FitError
+    ) -> List[NodeInfo]:
+        """generic_scheduler.go:1033: skip UnschedulableAndUnresolvable."""
+        out = []
+        for ni in self.algorithm.snapshot.list_node_infos():
+            status = fit_err.filtered_nodes_statuses.get(ni.node_name)
+            if (
+                status is not None
+                and status.code == StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE
+            ):
+                continue
+            out.append(ni)
+        return out
+
+    def select_victims_on_node(
+        self,
+        prof,
+        state: CycleState,
+        pod: Pod,
+        node_info: NodeInfo,
+        pdbs: List[PodDisruptionBudget],
+    ) -> Tuple[List[Pod], int, bool]:
+        """generic_scheduler.go:940 on cloned state/nodeinfo."""
+        node_info = node_info.clone()
+        state = state.clone()
+
+        def remove_pod(p: Pod) -> None:
+            node_info.remove_pod(p)
+            prof.run_pre_filter_extension_remove_pod(state, pod, p, node_info)
+
+        def add_pod(p: Pod) -> None:
+            node_info.add_pod(p)
+            prof.run_pre_filter_extension_add_pod(state, pod, p, node_info)
+
+        potential: List[Pod] = []
+        for p in list(node_info.pods):
+            if p.spec.priority < pod.spec.priority:
+                potential.append(p)
+                remove_pod(p)
+        fits, _ = self.algorithm.pod_passes_filters_on_node(
+            prof, state, pod, node_info
+        )
+        if not fits:
+            return [], 0, False
+
+        potential.sort(
+            key=lambda p: (-p.spec.priority, pod_start_time(p))
+        )  # MoreImportantPod order
+        violating, non_violating = filter_pods_with_pdb_violation(
+            potential, pdbs
+        )
+        victims: List[Pod] = []
+        num_violating = 0
+
+        def reprieve(p: Pod) -> bool:
+            add_pod(p)
+            fits, _ = self.algorithm.pod_passes_filters_on_node(
+                prof, state, pod, node_info
+            )
+            if not fits:
+                remove_pod(p)
+                victims.append(p)
+            return fits
+
+        for p in violating:
+            if not reprieve(p):
+                num_violating += 1
+        for p in non_violating:
+            reprieve(p)
+        return victims, num_violating, True
+
+    def find_preemption(
+        self, prof, state: CycleState, pod: Pod, fit_err: FitError
+    ) -> Tuple[str, List[Pod], List[Pod]]:
+        """generic_scheduler.go:270 Preempt. Returns
+        (node_name, victims, nominated_pods_to_clear)."""
+        if not self.pod_eligible_to_preempt_others(pod):
+            return "", [], []
+        potential = self.nodes_where_preemption_might_help(fit_err)
+        if not potential:
+            return "", [], [pod]  # clear any stale nomination
+        pdbs = []
+        if self.client is not None:
+            try:
+                pdbs, _ = self.client.list_pdbs()
+            except Exception:
+                logger.exception("listing PDBs")
+        nodes_to_victims: Dict[str, Victims] = {}
+        for ni in potential:
+            victims, num_violating, fits = self.select_victims_on_node(
+                prof, state, pod, ni, pdbs
+            )
+            if fits:
+                nodes_to_victims[ni.node_name] = Victims(victims, num_violating)
+        node_name = pick_one_node_for_preemption(nodes_to_victims)
+        if node_name is None:
+            return "", [], []
+        nominated_to_clear = self._lower_priority_nominated_pods(pod, node_name)
+        return node_name, nodes_to_victims[node_name].pods, nominated_to_clear
+
+    def _lower_priority_nominated_pods(
+        self, pod: Pod, node_name: str
+    ) -> List[Pod]:
+        """generic_scheduler.go:364."""
+        if self.queue is None:
+            return []
+        nominated = self.queue.nominated_pods_for_node(node_name)
+        return [p for p in nominated if p.spec.priority < pod.spec.priority]
+
+    # -- host-side actions (scheduler.go:392) --------------------------------
+
+    def preempt(
+        self, prof, state: CycleState, pod: Pod, fit_err: FitError
+    ) -> str:
+        if self.client is not None:
+            try:
+                pod = self.client.get_pod(
+                    pod.metadata.namespace, pod.metadata.name
+                )
+            except KeyError:
+                return ""
+        node_name, victims, to_clear = self.find_preemption(
+            prof, state, pod, fit_err
+        )
+        if node_name:
+            self.queue.update_nominated_pod_for_node(pod, node_name)
+            if self.client is not None:
+                try:
+                    def set_nominated(p: Pod) -> None:
+                        p.status.nominated_node_name = node_name
+
+                    self.client.update_pod_status(
+                        pod.metadata.namespace, pod.metadata.name, set_nominated
+                    )
+                except Exception:
+                    logger.exception("setting nominatedNodeName")
+                    self.queue.delete_nominated_pod_if_exists(pod)
+                    return ""
+            for victim in victims:
+                if self.client is not None:
+                    try:
+                        self.client.delete_pod(
+                            victim.metadata.namespace, victim.metadata.name
+                        )
+                    except KeyError:
+                        pass
+                waiting = prof.get_waiting_pod(victim.metadata.uid)
+                if waiting is not None:
+                    waiting.reject("preempted")
+        for p in to_clear:
+            self.queue.delete_nominated_pod_if_exists(p)
+            if self.client is not None and p.status.nominated_node_name:
+                try:
+                    def clear(q: Pod) -> None:
+                        q.status.nominated_node_name = ""
+
+                    self.client.update_pod_status(
+                        p.metadata.namespace, p.metadata.name, clear
+                    )
+                except Exception:
+                    logger.exception("clearing nominatedNodeName")
+        return node_name

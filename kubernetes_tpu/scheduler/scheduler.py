@@ -454,7 +454,9 @@ def new_scheduler(
             async_binding=async_binding,
         )
     from kubernetes_tpu.scheduler.eventhandlers import add_all_event_handlers
+    from kubernetes_tpu.scheduler.preemption import Preemptor
 
+    sched.preemptor = Preemptor(algorithm, queue, client)
     add_all_event_handlers(sched, informer_factory)
     return sched
 

@@ -223,6 +223,73 @@ class TestRegressions:
         assert not client.get_pod("default", "low").spec.node_name
 
 
+class TestExistingAntiAffinityGate:
+    def test_existing_required_anti_affinity_respected(self, cluster):
+        """A pod with no affinity of its own must still honor required
+        anti-affinity declared by pods already on nodes (symmetric check);
+        the batch path falls back to the sequential oracle for this."""
+        server, client, informers, sched = cluster
+        for name in ("a", "b"):
+            client.create_node(
+                make_node(name).labels(host=name)
+                .capacity(cpu="8", memory="16Gi").obj()
+            )
+        informers.start()
+        informers.wait_for_cache_sync()
+        # guard on node a: anti-affinity against app=web on its host
+        guard = (
+            make_pod("guard").labels(app="guard")
+            .container(cpu="100m")
+            .pod_affinity("host", {"app": "web"}, anti=True)
+            .obj()
+        )
+        client.create_pod(guard)
+        sched.start()
+        _wait_all_bound(client, 1)
+        sched.wait_for_inflight_binds()
+        guard_node = client.get_pod("default", "guard").spec.node_name
+        for i in range(4):
+            client.create_pod(
+                make_pod(f"web-{i}").labels(app="web").container(cpu="100m").obj()
+            )
+        pods = _wait_all_bound(client, 5)
+        for p in pods:
+            if p.name.startswith("web"):
+                assert p.spec.node_name != guard_node, p.name
+
+
+class TestNominatedOverlay:
+    def test_batch_does_not_steal_nominated_capacity(self, cluster):
+        """Capacity freed by preemption stays reserved for the nominee."""
+        server, client, informers, sched = cluster
+        client.create_node(make_node("n").capacity(cpu="2", memory="8Gi").obj())
+        informers.start()
+        informers.wait_for_cache_sync()
+        for i in range(2):
+            client.create_pod(make_pod(f"low{i}").container(cpu="1").obj())
+        sched.start()
+        _wait_all_bound(client, 2)
+        sched.wait_for_inflight_binds()
+        # high-priority pod preempts a victim and gets nominated
+        high = make_pod("high").container(cpu="2").obj()
+        high.spec.priority = 100
+        client.create_pod(high)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            hp = client.get_pod("default", "high")
+            if hp.spec.node_name:
+                break
+            # meanwhile, opportunistic low-priority pods keep arriving
+            time.sleep(0.2)
+            client.create_pod(
+                make_pod(f"opportunist-{time.monotonic_ns()}")
+                .container(cpu="1").obj()
+            )
+        sched.stop()
+        hp = client.get_pod("default", "high")
+        assert hp.spec.node_name == "n", "nominee starved by batch pods"
+
+
 class TestSolverSupported:
     def test_plain_pod(self):
         assert solver_supported(make_pod("p").container(cpu="1").obj())

@@ -1,0 +1,205 @@
+"""Preemption tests (reference pattern: preemption in
+generic_scheduler_test.go + test/integration/scheduler/preemption_test.go)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import LabelSelector, PodDisruptionBudget
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.cache.snapshot import new_snapshot
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.framework.interface import CycleState, FitError, Status
+from kubernetes_tpu.scheduler.preemption import (
+    Preemptor,
+    filter_pods_with_pdb_violation,
+    pick_one_node_for_preemption,
+    Victims,
+)
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _make_preemptor_env(pods, nodes, plugins=None):
+    """In-memory algorithm + framework against a static snapshot."""
+    from kubernetes_tpu.cache.cache import SchedulerCache
+    from kubernetes_tpu.framework.runtime import Framework
+    from kubernetes_tpu.plugins import new_in_tree_registry
+    from kubernetes_tpu.scheduler.generic import GenericScheduler
+    from kubernetes_tpu.scheduler.provider import default_plugins
+
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    for p in pods:
+        cache.add_pod(p)
+    snapshot = new_snapshot([], [])
+    algorithm = GenericScheduler(cache, snapshot)
+    registry = new_in_tree_registry()
+    fw = Framework(
+        registry,
+        default_plugins(),
+        snapshot_provider=lambda: snapshot,
+    )
+    return algorithm, fw
+
+
+def _schedule_fail(algorithm, fw, pod):
+    state = CycleState()
+    with pytest.raises(FitError) as exc:
+        algorithm.schedule(fw, state, pod)
+    return state, exc.value
+
+
+class TestSelectVictims:
+    def test_evicts_lowest_priority_first(self):
+        node = make_node("n").capacity(cpu="2", memory="4Gi").obj()
+        low = make_pod("low").node("n").container(cpu="1").obj()
+        mid = make_pod("mid").node("n").container(cpu="1").obj()
+        low.spec.priority, mid.spec.priority = 0, 5
+        algorithm, fw = _make_preemptor_env([low, mid], [node])
+        preemptor_pod = make_pod("high").container(cpu="1").obj()
+        preemptor_pod.spec.priority = 10
+        state, fit_err = _schedule_fail(algorithm, fw, preemptor_pod)
+
+        p = Preemptor(algorithm, None, None)
+        ni = algorithm.snapshot.get_node_info("n")
+        victims, violations, fits = p.select_victims_on_node(
+            fw, state, preemptor_pod, ni, []
+        )
+        assert fits
+        # mid is reprieved (removing low frees 1 cpu), low is the victim
+        assert [v.name for v in victims] == ["low"]
+        assert violations == 0
+
+    def test_no_preemption_when_pod_too_big(self):
+        node = make_node("n").capacity(cpu="2", memory="4Gi").obj()
+        low = make_pod("low").node("n").container(cpu="1").obj()
+        algorithm, fw = _make_preemptor_env([low], [node])
+        preemptor_pod = make_pod("huge").container(cpu="64").obj()
+        preemptor_pod.spec.priority = 10
+        state, fit_err = _schedule_fail(algorithm, fw, preemptor_pod)
+        p = Preemptor(algorithm, None, None)
+        ni = algorithm.snapshot.get_node_info("n")
+        _, _, fits = p.select_victims_on_node(fw, state, preemptor_pod, ni, [])
+        assert not fits
+
+    def test_equal_priority_not_preempted(self):
+        node = make_node("n").capacity(cpu="1", memory="4Gi").obj()
+        peer = make_pod("peer").node("n").container(cpu="1").obj()
+        peer.spec.priority = 10
+        algorithm, fw = _make_preemptor_env([peer], [node])
+        preemptor_pod = make_pod("same").container(cpu="1").obj()
+        preemptor_pod.spec.priority = 10
+        state, fit_err = _schedule_fail(algorithm, fw, preemptor_pod)
+        p = Preemptor(algorithm, None, None)
+        ni = algorithm.snapshot.get_node_info("n")
+        _, _, fits = p.select_victims_on_node(fw, state, preemptor_pod, ni, [])
+        assert not fits
+
+
+class TestPDB:
+    def test_pdb_budget_splits_violating(self):
+        pdb = PodDisruptionBudget(
+            selector=LabelSelector(match_labels={"app": "db"})
+        )
+        pdb.status.disruptions_allowed = 1
+        pods = [
+            make_pod(f"db{i}").labels(app="db").obj() for i in range(3)
+        ]
+        violating, non_violating = filter_pods_with_pdb_violation(pods, [pdb])
+        assert len(non_violating) == 1  # first one spends the budget
+        assert len(violating) == 2
+
+    def test_unlabeled_pods_never_violate(self):
+        pdb = PodDisruptionBudget(selector=LabelSelector())
+        pdb.status.disruptions_allowed = 0
+        pods = [make_pod("x").obj()]
+        violating, non_violating = filter_pods_with_pdb_violation(pods, [pdb])
+        assert not violating
+
+
+class TestPickNode:
+    def _victims(self, *prios, violations=0, start=None):
+        pods = []
+        for i, pr in enumerate(sorted(prios, reverse=True)):
+            p = make_pod(f"v{pr}-{i}").obj()
+            p.spec.priority = pr
+            p.status.start_time = (start or 100.0) + i
+            pods.append(p)
+        return Victims(pods, violations)
+
+    def test_free_lunch_wins(self):
+        choice = pick_one_node_for_preemption(
+            {"a": self._victims(5), "b": Victims([], 0)}
+        )
+        assert choice == "b"
+
+    def test_min_pdb_violations(self):
+        choice = pick_one_node_for_preemption(
+            {"a": self._victims(1, violations=1), "b": self._victims(5)}
+        )
+        assert choice == "b"
+
+    def test_min_highest_priority(self):
+        choice = pick_one_node_for_preemption(
+            {"a": self._victims(10), "b": self._victims(5)}
+        )
+        assert choice == "b"
+
+    def test_min_priority_sum(self):
+        choice = pick_one_node_for_preemption(
+            {"a": self._victims(5, 5), "b": self._victims(5, 1)}
+        )
+        assert choice == "b"
+
+    def test_min_victim_count(self):
+        choice = pick_one_node_for_preemption(
+            {"a": self._victims(5, 5, 5), "b": self._victims(5, 5)}
+        )
+        assert choice == "b"
+
+
+class TestEndToEnd:
+    def test_preempt_then_schedule(self):
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(client, informers)
+        client.create_node(make_node("n").capacity(cpu="2", memory="4Gi").obj())
+        informers.start()
+        informers.wait_for_cache_sync()
+        # fill the node with two low-priority pods
+        for i in range(2):
+            client.create_pod(
+                make_pod(f"low{i}").container(cpu="1").obj()
+            )
+        t = sched.start()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            pods, _ = client.list_pods()
+            if all(p.spec.node_name for p in pods):
+                break
+            time.sleep(0.05)
+        # high-priority pod arrives: a victim gets deleted, pod nominated
+        high = make_pod("high").container(cpu="1").obj()
+        high.spec.priority = 100
+        client.create_pod(high)
+        deadline = time.time() + 10
+        bound = False
+        while time.time() < deadline:
+            try:
+                hp = client.get_pod("default", "high")
+            except KeyError:
+                break
+            if hp.spec.node_name:
+                bound = True
+                break
+            time.sleep(0.05)
+        sched.stop()
+        informers.stop()
+        assert bound, "high-priority pod never bound after preemption"
+        pods, _ = client.list_pods()
+        low_alive = [p for p in pods if p.name.startswith("low")]
+        assert len(low_alive) == 1  # exactly one victim deleted
