@@ -372,6 +372,149 @@ class Node:
 
 
 # ---------------------------------------------------------------------------
+# Storage objects (scheduler-relevant subset of core/v1 + storage/v1;
+# consumed by the volume plugins and the volume binder)
+# ---------------------------------------------------------------------------
+
+# zone/region label keys: GA topology labels plus the v1.18-era beta names
+# (reference uses v1.LabelZoneFailureDomain = failure-domain.beta...)
+LABEL_ZONE_KEYS = (
+    "topology.kubernetes.io/zone",
+    "failure-domain.beta.kubernetes.io/zone",
+)
+LABEL_REGION_KEYS = (
+    "topology.kubernetes.io/region",
+    "failure-domain.beta.kubernetes.io/region",
+)
+
+VOLUME_BINDING_IMMEDIATE = "Immediate"
+VOLUME_BINDING_WAIT = "WaitForFirstConsumer"
+
+
+@dataclass
+class StorageClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    provisioner: str = ""
+    volume_binding_mode: str = VOLUME_BINDING_IMMEDIATE
+
+    kind: str = "StorageClass"
+
+    def key(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class PersistentVolume:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    capacity_bytes: int = 0
+    storage_class_name: str = ""
+    # binding state
+    claim_ref_namespace: str = ""
+    claim_ref_name: str = ""
+    # topology: required node affinity (VolumeNodeAffinity.Required)
+    node_affinity: Optional[NodeSelector] = None
+    # flattened sources for limit counting (csi driver or in-tree type)
+    csi_driver: str = ""
+    csi_volume_handle: str = ""
+    gce_pd_name: str = ""
+    aws_ebs_volume_id: str = ""
+    azure_disk_name: str = ""
+
+    kind: str = "PersistentVolume"
+
+    def key(self) -> str:
+        return self.metadata.name
+
+    def is_bound_to(self, namespace: str, name: str) -> bool:
+        return (
+            self.claim_ref_namespace == namespace
+            and self.claim_ref_name == name
+        )
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    volume_name: str = ""  # set when bound
+    storage_class_name: str = ""
+    requested_bytes: int = 0
+    phase: str = "Pending"  # Pending | Bound | Lost
+
+    kind: str = "PersistentVolumeClaim"
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+@dataclass
+class CSINodeDriver:
+    name: str = ""
+    node_id: str = ""
+    allocatable_count: Optional[int] = None  # max attachable volumes
+
+
+@dataclass
+class CSINode:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    drivers: List[CSINodeDriver] = field(default_factory=list)
+
+    kind: str = "CSINode"
+
+    def key(self) -> str:
+        return self.metadata.name
+
+
+# ---------------------------------------------------------------------------
+# Service / workload controllers (consumed by SelectorSpread +
+# ServiceAffinity; reference defaultpodtopologyspread + serviceaffinity)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Service:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Dict[str, str] = field(default_factory=dict)
+
+    kind: str = "Service"
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+@dataclass
+class ReplicationController:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Dict[str, str] = field(default_factory=dict)
+
+    kind: str = "ReplicationController"
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+@dataclass
+class ReplicaSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+
+    kind: str = "ReplicaSet"
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+@dataclass
+class StatefulSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+
+    kind: str = "StatefulSet"
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+# ---------------------------------------------------------------------------
 # Binding (the pods/binding subresource payload,
 # reference pkg/registry/core/pod/storage/storage.go:142)
 # ---------------------------------------------------------------------------

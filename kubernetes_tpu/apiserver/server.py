@@ -91,8 +91,13 @@ def _obj_key(obj: Any) -> Tuple[str, str]:
 class APIServer:
     """Multi-kind object store with watch fan-out."""
 
-    #: kinds with namespaced storage
-    KINDS = ("Pod", "Node", "PodDisruptionBudget", "PodGroup", "Lease", "Service")
+    #: pre-registered kinds; any other kind gets a store on first use
+    #: (the REST-registry analogue: pkg/registry/ storage per resource)
+    KINDS = (
+        "Pod", "Node", "PodDisruptionBudget", "PodGroup", "Lease", "Service",
+        "PersistentVolume", "PersistentVolumeClaim", "StorageClass",
+        "CSINode", "ReplicationController", "ReplicaSet", "StatefulSet",
+    )
 
     def __init__(self, watch_history_limit: int = 200_000) -> None:
         self._lock = threading.RLock()
@@ -104,6 +109,12 @@ class APIServer:
         # bounded per-kind event history for watch(since_rv) replay
         self._history: Dict[str, List[WatchEvent]] = {k: [] for k in self.KINDS}
         self._history_limit = watch_history_limit
+
+    def _ensure_kind(self, kind: str) -> None:
+        if kind not in self._stores:
+            self._stores[kind] = {}
+            self._watches[kind] = []
+            self._history[kind] = []
 
     # -- core ---------------------------------------------------------------
 
@@ -128,6 +139,7 @@ class APIServer:
     def create(self, obj: Any) -> Any:
         kind = obj.kind
         with self._lock:
+            self._ensure_kind(kind)
             store = self._stores[kind]
             key = _obj_key(obj)
             if key in store:
@@ -139,6 +151,7 @@ class APIServer:
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
         with self._lock:
+            self._ensure_kind(kind)
             obj = self._stores[kind].get((namespace, name))
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
@@ -147,12 +160,14 @@ class APIServer:
     def list(self, kind: str) -> Tuple[List[Any], int]:
         """Returns (objects, resourceVersion) -- the list+watch handshake."""
         with self._lock:
+            self._ensure_kind(kind)
             return list(self._stores[kind].values()), self._rv
 
     def update(self, obj: Any, expect_rv: Optional[int] = None) -> Any:
         """Replace; optimistic-concurrency check when expect_rv given."""
         kind = obj.kind
         with self._lock:
+            self._ensure_kind(kind)
             store = self._stores[kind]
             key = _obj_key(obj)
             current = store.get(key)
@@ -199,6 +214,7 @@ class APIServer:
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:
         with self._lock:
+            self._ensure_kind(kind)
             obj = self._stores[kind].pop((namespace, name), None)
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
@@ -210,6 +226,7 @@ class APIServer:
 
     def watch(self, kind: str, since_rv: int = 0) -> Watch:
         with self._lock:
+            self._ensure_kind(kind)
             w = Watch(self, kind)
             for ev in self._history[kind]:
                 if ev.resource_version > since_rv:

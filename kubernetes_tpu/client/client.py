@@ -72,6 +72,19 @@ class Client:
     def list_pod_groups(self) -> Tuple[List[PodGroup], int]:
         return self._server.list("PodGroup")
 
+    # storage + services (generic create/list over the object store)
+    def create(self, obj) -> object:
+        return self._server.create(obj)
+
+    def list(self, kind: str) -> Tuple[List[object], int]:
+        return self._server.list(kind)
+
+    def get(self, kind: str, namespace: str, name: str):
+        return self._server.get(kind, namespace, name)
+
+    def update(self, obj, expect_rv: Optional[int] = None):
+        return self._server.update(obj, expect_rv)
+
     # raw access (leases for leader election, etc.)
     @property
     def server(self) -> APIServer:

@@ -170,12 +170,19 @@ class InformerFactory:
     def __init__(self, server: APIServer):
         self._server = server
         self._informers: Dict[str, Informer] = {}
+        self._started = False
 
     def informer(self, kind: str) -> Informer:
         inf = self._informers.get(kind)
         if inf is None:
             inf = Informer(self._server, kind)
             self._informers[kind] = inf
+            # informers requested after Start (e.g. lazily by a plugin's
+            # first Filter call) must sync too -- the reference starts
+            # late informers on the next factory.Start; here we start
+            # them immediately so listers are never silently empty
+            if self._started:
+                inf.start()
         return inf
 
     def pods(self) -> Informer:
@@ -190,8 +197,33 @@ class InformerFactory:
     def pod_groups(self) -> Informer:
         return self.informer("PodGroup")
 
+    def services(self) -> Informer:
+        return self.informer("Service")
+
+    def replication_controllers(self) -> Informer:
+        return self.informer("ReplicationController")
+
+    def replica_sets(self) -> Informer:
+        return self.informer("ReplicaSet")
+
+    def stateful_sets(self) -> Informer:
+        return self.informer("StatefulSet")
+
+    def persistent_volumes(self) -> Informer:
+        return self.informer("PersistentVolume")
+
+    def persistent_volume_claims(self) -> Informer:
+        return self.informer("PersistentVolumeClaim")
+
+    def storage_classes(self) -> Informer:
+        return self.informer("StorageClass")
+
+    def csi_nodes(self) -> Informer:
+        return self.informer("CSINode")
+
     def start(self) -> None:
-        for inf in self._informers.values():
+        self._started = True
+        for inf in list(self._informers.values()):
             inf.start()
 
     def pump(self) -> int:

@@ -23,7 +23,9 @@ def new_in_tree_registry() -> Registry:
         nodeunschedulable,
         podtopologyspread,
         queuesort,
+        selectorspread,
         tainttoleration,
+        volumes,
     )
 
     r = Registry()
@@ -74,5 +76,27 @@ def new_in_tree_registry() -> Registry:
     r.register(
         interpodaffinity.InterPodAffinity.NAME,
         lambda a, h: interpodaffinity.InterPodAffinity(a, h),
+    )
+    r.register(
+        volumes.VolumeRestrictions.NAME, lambda a, h: volumes.VolumeRestrictions()
+    )
+    r.register(volumes.VolumeZone.NAME, lambda a, h: volumes.VolumeZone(h))
+    r.register(volumes.CSILimits.NAME, lambda a, h: volumes.CSILimits(h))
+    r.register(volumes.EBSLimits.NAME, lambda a, h: volumes.EBSLimits(h))
+    r.register(volumes.GCEPDLimits.NAME, lambda a, h: volumes.GCEPDLimits(h))
+    r.register(
+        volumes.AzureDiskLimits.NAME, lambda a, h: volumes.AzureDiskLimits(h)
+    )
+    r.register(volumes.VolumeBinding.NAME, lambda a, h: volumes.VolumeBinding(h))
+    r.register(
+        selectorspread.DefaultPodTopologySpread.NAME,
+        lambda a, h: selectorspread.DefaultPodTopologySpread(h),
+    )
+    r.register(
+        selectorspread.ServiceAffinity.NAME,
+        lambda a, h: selectorspread.ServiceAffinity(a, h),
+    )
+    r.register(
+        selectorspread.NodeLabel.NAME, lambda a, h: selectorspread.NodeLabel(a)
     )
     return r

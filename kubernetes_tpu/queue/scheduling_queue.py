@@ -355,6 +355,16 @@ class PriorityQueue:
         with self._lock:
             self.nominated_pods.delete(pod)
 
+    def all_nominated_pods_by_node(self) -> Dict[str, List[Pod]]:
+        """Locked snapshot of the nominated map (node -> pods); the batch
+        solver's capacity-overlay input."""
+        with self._lock:
+            return {
+                node: list(pods)
+                for node, pods in self.nominated_pods.nominated_pods.items()
+                if node
+            }
+
     def nominated_pods_for_node(self, node_name: str) -> List[Pod]:
         with self._lock:
             return self.nominated_pods.pods_for_node(node_name)

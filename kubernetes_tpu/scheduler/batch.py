@@ -55,6 +55,14 @@ def solver_supported(pod: Pod) -> bool:
         for p in c.ports:
             if p.host_port:
                 return False
+    # volume feasibility (PVC binding, disk conflicts, zone/limit checks)
+    # stays host-side
+    for v in spec.volumes:
+        if (
+            v.pvc_claim_name or v.gce_pd_name or v.aws_ebs_volume_id
+            or v.iscsi_target or v.rbd_image
+        ):
+            return False
     return True
 
 
@@ -121,14 +129,11 @@ class BatchScheduler(Scheduler):
             return 0
         pod_scheduling_cycle = self.queue.scheduling_cycle
 
-        snapshot = self.algorithm.snapshot
-        self.cache.update_snapshot(snapshot)
-        device_ok = cluster_solver_compatible(snapshot)
-
         # Process in activeQ order: a fallback pod must not jump ahead of
         # higher-priority solver pods popped before it, so solver runs are
-        # flushed at each fallback boundary (each flush re-snapshots, so
-        # fallback capacity claims are visible to later solver pods).
+        # flushed at each fallback boundary (each flush re-snapshots and
+        # re-checks cluster compatibility, so fallback capacity claims and
+        # newly-placed anti-affinity pods are visible to later solver pods).
         solver_infos: List[PodInfo] = []
 
         def flush() -> None:
@@ -140,7 +145,7 @@ class BatchScheduler(Scheduler):
         for pi in batch_infos:
             if self._skip_pod_schedule(pi.pod):
                 continue
-            if device_ok and solver_supported(pi.pod):
+            if solver_supported(pi.pod):
                 solver_infos.append(pi)
             else:
                 flush()
@@ -154,6 +159,13 @@ class BatchScheduler(Scheduler):
     ) -> None:
         snapshot = self.algorithm.snapshot
         self.cache.update_snapshot(snapshot)
+        if not cluster_solver_compatible(snapshot):
+            # a fallback pod placed earlier in this batch (or informer
+            # churn) introduced constraints the device can't model yet
+            for pi in solver_infos:
+                self.pods_fallback += 1
+                self.attempt_schedule(pi)
+            return
         nt = self.tensor_cache.update(snapshot)
         pods = [pi.pod for pi in solver_infos]
         batch = pack_pod_batch(
@@ -170,8 +182,8 @@ class BatchScheduler(Scheduler):
         node_requested, node_nzr = nt.requested, nt.non_zero_requested
         batch_uids = {pi.pod.metadata.uid for pi in solver_infos}
         copied = False
-        for node_name, nominated in self.queue.nominated_pods.nominated_pods.items():
-            if not node_name or node_name not in nt.names:
+        for node_name, nominated in self.queue.all_nominated_pods_by_node().items():
+            if node_name not in nt.names:
                 continue
             j = nt.row(node_name)
             for npod in nominated:

@@ -29,7 +29,14 @@ def default_plugins() -> Plugins:
                 P("NodeName"),
                 P("NodePorts"),
                 P("NodeAffinity"),
+                P("VolumeRestrictions"),
                 P("TaintToleration"),
+                P("EBSLimits"),
+                P("GCEPDLimits"),
+                P("AzureDiskLimits"),
+                P("NodeVolumeLimitsCSI"),
+                P("VolumeBinding"),
+                P("VolumeZone"),
                 P("PodTopologySpread"),
                 P("InterPodAffinity"),
             ]
@@ -38,6 +45,7 @@ def default_plugins() -> Plugins:
             enabled=[
                 P("InterPodAffinity"),
                 P("PodTopologySpread"),
+                P("DefaultPodTopologySpread"),
                 P("TaintToleration"),
             ]
         ),
@@ -49,10 +57,15 @@ def default_plugins() -> Plugins:
                 P("NodeResourcesLeastAllocated", weight=1),
                 P("NodeAffinity", weight=1),
                 P("NodePreferAvoidPods", weight=10000),
+                P("DefaultPodTopologySpread", weight=1),
                 P("PodTopologySpread", weight=2),
                 P("TaintToleration", weight=1),
             ]
         ),
+        # v1.18 binds volumes via the scheduler's VolumeBinder call
+        # (scheduler.go:693 bindVolumes); this build routes it through the
+        # PreBind extension point of the same plugin (volumes.py docstring)
+        pre_bind=PluginSet(enabled=[P("VolumeBinding")]),
         bind=PluginSet(enabled=[P("DefaultBinder")]),
     )
 

@@ -458,6 +458,14 @@ def new_scheduler(
 
     sched.preemptor = Preemptor(algorithm, queue, client)
     add_all_event_handlers(sched, informer_factory)
+    # materialize every plugin-consumed informer BEFORE factory start so
+    # listers are synced by WaitForCacheSync (reference factory.go shape)
+    for accessor in (
+        "pdbs", "pod_groups", "services", "replication_controllers",
+        "replica_sets", "stateful_sets", "persistent_volumes",
+        "persistent_volume_claims", "storage_classes", "csi_nodes",
+    ):
+        getattr(informer_factory, accessor)()
     return sched
 
 

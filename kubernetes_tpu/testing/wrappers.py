@@ -34,6 +34,7 @@ from kubernetes_tpu.api.types import (
     Taint,
     Toleration,
     TopologySpreadConstraint,
+    Volume,
     WeightedPodAffinityTerm,
 )
 
@@ -209,6 +210,22 @@ class PodWrapper:
                 when_unsatisfiable=when_unsatisfiable,
                 label_selector=LabelSelector(match_labels=match_labels or {}),
             )
+        )
+        return self
+
+    def pvc(self, claim_name: str) -> "PodWrapper":
+        self.pod.spec.volumes.append(Volume(name=claim_name, pvc_claim_name=claim_name))
+        return self
+
+    def gce_pd(self, pd_name: str, read_only: bool = False) -> "PodWrapper":
+        self.pod.spec.volumes.append(
+            Volume(name=pd_name, gce_pd_name=pd_name, read_only=read_only)
+        )
+        return self
+
+    def ebs(self, volume_id: str) -> "PodWrapper":
+        self.pod.spec.volumes.append(
+            Volume(name=volume_id, aws_ebs_volume_id=volume_id)
         )
         return self
 

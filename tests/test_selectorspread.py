@@ -1,0 +1,161 @@
+"""SelectorSpread / ServiceAffinity / NodeLabel tests."""
+
+import pytest
+
+from kubernetes_tpu.api.types import ObjectMeta, Service
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.cache.snapshot import new_snapshot
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.framework.interface import CycleState, NodeScore, StatusCode
+from kubernetes_tpu.plugins.selectorspread import (
+    DefaultPodTopologySpread,
+    NodeLabel,
+    ServiceAffinity,
+    get_zone_key,
+)
+from kubernetes_tpu.scheduler.generic import SNAPSHOT_STATE_KEY
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+class _Handle:
+    def __init__(self, informers):
+        self.informers = informers
+
+
+@pytest.fixture
+def env():
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    # materialize informers used by the plugins
+    for acc in ("services", "replication_controllers", "replica_sets",
+                "stateful_sets"):
+        getattr(informers, acc)()
+    return server, client, informers, _Handle(informers)
+
+
+def _state(pods, nodes):
+    snap = new_snapshot(pods, nodes)
+    state = CycleState()
+    state.write(SNAPSHOT_STATE_KEY, snap)
+    return state, snap
+
+
+class TestSelectorSpread:
+    def _score(self, env, pod, pods, nodes):
+        server, client, informers, handle = env
+        informers.pump()
+        state, snap = _state(pods, nodes)
+        pl = DefaultPodTopologySpread(handle)
+        assert pl.pre_score(state, pod, snap.list_node_infos()) is None
+        scores = []
+        for ni in snap.list_node_infos():
+            raw, status = pl.score(state, pod, ni.node_name)
+            assert status is None
+            scores.append(NodeScore(ni.node_name, raw))
+        assert pl.normalize_score(state, pod, scores) is None
+        return {ns.name: ns.score for ns in scores}
+
+    def test_spreads_service_pods_across_nodes(self, env):
+        server, client, informers, handle = env
+        client.create(Service(
+            metadata=ObjectMeta(name="svc", namespace="default"),
+            selector={"app": "web"},
+        ))
+        nodes = [make_node("a").obj(), make_node("b").obj()]
+        pods = [make_pod("p1").node("a").labels(app="web").obj()]
+        pod = make_pod("new").labels(app="web").obj()
+        by_node = self._score(env, pod, pods, nodes)
+        assert by_node["b"] > by_node["a"]
+
+    def test_no_controller_all_equal(self, env):
+        nodes = [make_node("a").obj(), make_node("b").obj()]
+        pods = [make_pod("p1").node("a").labels(app="web").obj()]
+        pod = make_pod("new").labels(app="web").obj()
+        by_node = self._score(env, pod, pods, nodes)
+        assert by_node["a"] == by_node["b"] == 100
+
+    def test_zone_weighting(self, env):
+        server, client, informers, handle = env
+        client.create(Service(
+            metadata=ObjectMeta(name="svc", namespace="default"),
+            selector={"app": "web"},
+        ))
+        zkey = "topology.kubernetes.io/zone"
+        nodes = [
+            make_node("a1").label(zkey, "z1").obj(),
+            make_node("a2").label(zkey, "z1").obj(),
+            make_node("b1").label(zkey, "z2").obj(),
+        ]
+        # z1 heavily loaded: a1 has 2 pods, a2 has 0; z2 empty
+        pods = [
+            make_pod("p1").node("a1").labels(app="web").obj(),
+            make_pod("p2").node("a1").labels(app="web").obj(),
+        ]
+        pod = make_pod("new").labels(app="web").obj()
+        by_node = self._score(env, pod, pods, nodes)
+        # empty node in empty zone beats empty node in loaded zone
+        assert by_node["b1"] > by_node["a2"] > by_node["a1"]
+
+    def test_get_zone_key(self):
+        n = make_node("x").label("topology.kubernetes.io/zone", "z1") \
+            .label("topology.kubernetes.io/region", "r1").obj()
+        assert get_zone_key(n) == "r1:\x00:z1"
+        assert get_zone_key(make_node("y").obj()) == ""
+
+
+class TestServiceAffinity:
+    def test_label_homogeneity(self, env):
+        server, client, informers, handle = env
+        client.create(Service(
+            metadata=ObjectMeta(name="svc", namespace="default"),
+            selector={"app": "db"},
+        ))
+        informers.pump()
+        nodes = [
+            make_node("r1").labels(region="r1").obj(),
+            make_node("r2").labels(region="r2").obj(),
+        ]
+        mate = make_pod("mate").node("r1").labels(app="db").obj()
+        state, snap = _state([mate], nodes)
+        pl = ServiceAffinity({"affinity_labels": ["region"]}, handle)
+        pod = make_pod("new").labels(app="db").obj()
+        assert pl.pre_filter(state, pod) is None
+        assert pl.filter(state, pod, snap.get_node_info("r1")) is None
+        status = pl.filter(state, pod, snap.get_node_info("r2"))
+        assert status is not None and status.code == StatusCode.UNSCHEDULABLE
+
+    def test_first_pod_lands_anywhere(self, env):
+        server, client, informers, handle = env
+        informers.pump()
+        nodes = [make_node("r1").labels(region="r1").obj()]
+        state, snap = _state([], nodes)
+        pl = ServiceAffinity({"affinity_labels": ["region"]}, handle)
+        pod = make_pod("new").labels(app="db").obj()
+        assert pl.pre_filter(state, pod) is None
+        assert pl.filter(state, pod, snap.get_node_info("r1")) is None
+
+
+class TestNodeLabel:
+    def test_presence_absence(self):
+        pl = NodeLabel({"present_labels": ["ssd"], "absent_labels": ["spot"]})
+        state = CycleState()
+        from kubernetes_tpu.cache.node_info import NodeInfo
+        good = NodeInfo(make_node("g").labels(ssd="true").obj())
+        missing = NodeInfo(make_node("m").obj())
+        spotty = NodeInfo(make_node("s").labels(ssd="1", spot="1").obj())
+        assert pl.filter(state, make_pod("p").obj(), good) is None
+        assert pl.filter(state, make_pod("p").obj(), missing) is not None
+        assert pl.filter(state, make_pod("p").obj(), spotty) is not None
+
+    def test_conflicting_args_rejected(self):
+        with pytest.raises(ValueError):
+            NodeLabel({"present_labels": ["x"], "absent_labels": ["x"]})
+
+    def test_preference_score(self):
+        pl = NodeLabel({"present_labels_preference": ["ssd"]})
+        nodes = [make_node("a").labels(ssd="1").obj(), make_node("b").obj()]
+        state, snap = _state([], nodes)
+        assert pl.score(state, make_pod("p").obj(), "a")[0] == 100
+        assert pl.score(state, make_pod("p").obj(), "b")[0] == 0

@@ -1,0 +1,254 @@
+"""Volume plugin tests (reference pattern: volumerestrictions /
+volumezone / nodevolumelimits / volume_binding *_test.go)."""
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    CSINode,
+    CSINodeDriver,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    StorageClass,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.cache.node_info import NodeInfo
+from kubernetes_tpu.cache.snapshot import new_snapshot
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.framework.interface import CycleState, StatusCode
+from kubernetes_tpu.plugins import volumes
+from kubernetes_tpu.scheduler.generic import SNAPSHOT_STATE_KEY
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+class _Handle:
+    def __init__(self, informers, client=None):
+        self.informers = informers
+        self.client = client
+
+
+@pytest.fixture
+def env():
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    handle = _Handle(informers, client)
+    return server, client, informers, handle
+
+
+def _pump(informers):
+    informers.pump()
+
+
+def _cluster_meta(name, namespace=""):
+    return ObjectMeta(name=name, namespace=namespace)
+
+
+class TestVolumeRestrictions:
+    def test_gce_pd_rw_conflict(self):
+        pl = volumes.VolumeRestrictions()
+        existing = make_pod("a").gce_pd("disk-1").obj()
+        ni = NodeInfo(make_node("n").obj())
+        ni.add_pod(existing)
+        pod = make_pod("b").gce_pd("disk-1").obj()
+        status = pl.filter(CycleState(), pod, ni)
+        assert status is not None and status.code == StatusCode.UNSCHEDULABLE
+
+    def test_gce_pd_ro_ok(self):
+        pl = volumes.VolumeRestrictions()
+        existing = make_pod("a").gce_pd("disk-1", read_only=True).obj()
+        ni = NodeInfo(make_node("n").obj())
+        ni.add_pod(existing)
+        pod = make_pod("b").gce_pd("disk-1", read_only=True).obj()
+        assert pl.filter(CycleState(), pod, ni) is None
+
+    def test_ebs_always_conflicts(self):
+        pl = volumes.VolumeRestrictions()
+        existing = make_pod("a").ebs("vol-1").obj()
+        ni = NodeInfo(make_node("n").obj())
+        ni.add_pod(existing)
+        pod = make_pod("b").ebs("vol-1").obj()
+        assert pl.filter(CycleState(), pod, ni) is not None
+
+
+class TestVolumeZone:
+    def test_pv_zone_mismatch(self, env):
+        server, client, informers, handle = env
+        client.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="claim", namespace="default"),
+            volume_name="pv-1",
+        ))
+        pv = PersistentVolume(metadata=_cluster_meta("pv-1"))
+        pv.metadata.labels["topology.kubernetes.io/zone"] = "z1"
+        client.create(pv)
+        informers.persistent_volume_claims()
+        informers.persistent_volumes()
+        informers.storage_classes()
+        _pump(informers)
+
+        pl = volumes.VolumeZone(handle)
+        pod = make_pod("p").pvc("claim").obj()
+        good = NodeInfo(
+            make_node("n1").label("topology.kubernetes.io/zone", "z1").obj()
+        )
+        bad = NodeInfo(
+            make_node("n2").label("topology.kubernetes.io/zone", "z2").obj()
+        )
+        unlabeled = NodeInfo(make_node("n3").obj())
+        assert pl.filter(CycleState(), pod, good) is None
+        status = pl.filter(CycleState(), pod, bad)
+        assert status is not None
+        assert status.code == StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE
+        assert pl.filter(CycleState(), pod, unlabeled) is None
+
+
+class TestCSILimits:
+    def test_limit_enforced(self, env):
+        server, client, informers, handle = env
+        for i in range(3):
+            client.create(PersistentVolumeClaim(
+                metadata=ObjectMeta(name=f"c{i}", namespace="default"),
+                volume_name=f"pv{i}",
+            ))
+            client.create(PersistentVolume(
+                metadata=_cluster_meta(f"pv{i}"),
+                csi_driver="ebs.csi.aws.com",
+                csi_volume_handle=f"h{i}",
+            ))
+        client.create(CSINode(
+            metadata=_cluster_meta("n"),
+            drivers=[CSINodeDriver(name="ebs.csi.aws.com", allocatable_count=2)],
+        ))
+        informers.persistent_volume_claims()
+        informers.persistent_volumes()
+        informers.csi_nodes()
+        _pump(informers)
+
+        pl = volumes.CSILimits(handle)
+        ni = NodeInfo(make_node("n").obj())
+        ni.add_pod(make_pod("e0").pvc("c0").obj())
+        ni.add_pod(make_pod("e1").pvc("c1").obj())
+        pod = make_pod("new").pvc("c2").obj()
+        status = pl.filter(CycleState(), pod, ni)
+        assert status is not None and status.code == StatusCode.UNSCHEDULABLE
+        # same handle already in use does not count twice
+        again = make_pod("again").pvc("c0").obj()
+        assert pl.filter(CycleState(), again, ni) is None
+
+
+class TestVolumeBinding:
+    def _mk(self, env, *, binding_mode, with_pv=True, pv_zone=None,
+            provisioner="kubernetes.io/no-provisioner"):
+        server, client, informers, handle = env
+        client.create(StorageClass(
+            metadata=_cluster_meta("sc"),
+            provisioner=provisioner,
+            volume_binding_mode=binding_mode,
+        ))
+        client.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="claim", namespace="default"),
+            storage_class_name="sc",
+            requested_bytes=1 << 30,
+        ))
+        if with_pv:
+            pv = PersistentVolume(
+                metadata=_cluster_meta("pv-a"),
+                storage_class_name="sc",
+                capacity_bytes=2 << 30,
+            )
+            if pv_zone:
+                pv.node_affinity = NodeSelector(node_selector_terms=[
+                    NodeSelectorTerm(match_expressions=[
+                        NodeSelectorRequirement(
+                            key="zone", operator="In", values=[pv_zone]
+                        )
+                    ])
+                ])
+            client.create(pv)
+        for acc in ("persistent_volume_claims", "persistent_volumes",
+                    "storage_classes"):
+            getattr(informers, acc)()
+        _pump(informers)
+        return volumes.VolumeBinding(handle)
+
+    def test_unbound_immediate_unresolvable(self, env):
+        pl = self._mk(env, binding_mode="Immediate")
+        pod = make_pod("p").pvc("claim").obj()
+        status = pl.filter(CycleState(), pod, NodeInfo(make_node("n").obj()))
+        assert status is not None
+        assert status.code == StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_wait_mode_matches_pv_with_node_affinity(self, env):
+        pl = self._mk(env, binding_mode="WaitForFirstConsumer", pv_zone="z1")
+        pod = make_pod("p").pvc("claim").obj()
+        good = NodeInfo(make_node("n1").labels(zone="z1").obj())
+        bad = NodeInfo(make_node("n2").labels(zone="z2").obj())
+        assert pl.filter(CycleState(), pod, good) is None
+        assert pl.filter(CycleState(), pod, bad) is not None
+
+    def test_wait_mode_no_pv_no_provisioner_unschedulable(self, env):
+        pl = self._mk(env, binding_mode="WaitForFirstConsumer", with_pv=False)
+        pod = make_pod("p").pvc("claim").obj()
+        status = pl.filter(CycleState(), pod, NodeInfo(make_node("n").obj()))
+        assert status is not None and status.code == StatusCode.UNSCHEDULABLE
+
+    def test_wait_mode_dynamic_provisioner_ok(self, env):
+        pl = self._mk(env, binding_mode="WaitForFirstConsumer",
+                      with_pv=False, provisioner="pd.csi.storage.gke.io")
+        pod = make_pod("p").pvc("claim").obj()
+        assert pl.filter(CycleState(), pod, NodeInfo(make_node("n").obj())) is None
+
+    def test_pre_bind_binds_pv(self, env):
+        server, client, informers, handle = env
+        pl = self._mk(env, binding_mode="WaitForFirstConsumer")
+        pod = make_pod("p").pvc("claim").obj()
+        node = make_node("n").obj()
+        snap = new_snapshot([], [node])
+        state = CycleState()
+        state.write(SNAPSHOT_STATE_KEY, snap)
+        assert pl.pre_bind(state, pod, "n") is None
+        pv = server.get("PersistentVolume", "", "pv-a")
+        assert pv.claim_ref_name == "claim"
+        pvc = server.get("PersistentVolumeClaim", "default", "claim")
+        assert pvc.volume_name == "pv-a"
+        assert pvc.phase == "Bound"
+
+
+class TestBoundPVNodeAffinity:
+    def test_bound_claim_respects_pv_affinity(self, env):
+        server, client, informers, handle = env
+        pv = PersistentVolume(
+            metadata=_cluster_meta("pv-b"),
+            storage_class_name="sc",
+            capacity_bytes=1 << 30,
+            claim_ref_namespace="default",
+            claim_ref_name="claim",
+            node_affinity=NodeSelector(node_selector_terms=[
+                NodeSelectorTerm(match_expressions=[
+                    NodeSelectorRequirement(
+                        key="zone", operator="In", values=["z1"]
+                    )
+                ])
+            ]),
+        )
+        client.create(pv)
+        client.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="claim", namespace="default"),
+            volume_name="pv-b",
+        ))
+        for acc in ("persistent_volume_claims", "persistent_volumes",
+                    "storage_classes"):
+            getattr(informers, acc)()
+        _pump(informers)
+        pl = volumes.VolumeBinding(handle)
+        pod = make_pod("p").pvc("claim").obj()
+        good = NodeInfo(make_node("n1").labels(zone="z1").obj())
+        bad = NodeInfo(make_node("n2").labels(zone="z2").obj())
+        assert pl.filter(CycleState(), pod, good) is None
+        status = pl.filter(CycleState(), pod, bad)
+        assert status is not None
+        assert status.code == StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE
