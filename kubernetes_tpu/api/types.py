@@ -529,6 +529,27 @@ class Binding:
 
 
 # ---------------------------------------------------------------------------
+# Lease (coordination.k8s.io) -- leader election + node heartbeats
+# (reference tools/leaderelection + kubelet.go:885)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Lease:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder_identity: str = ""
+    lease_duration_seconds: float = 15.0
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_transitions: int = 0
+
+    kind: str = "Lease"
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+# ---------------------------------------------------------------------------
 # PodDisruptionBudget (policy/v1beta1) -- consumed by preemption
 # (reference generic_scheduler.go:885)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,150 @@
+"""KubeSchedulerConfiguration loading: YAML/JSON -> typed config.
+
+Reference: the layered config system (SURVEY.md section 5): versioned
+ComponentConfig decoded with defaulting (apis/config/v1alpha2), feature
+gates (component-base/featuregate), per-plugin args. Field names accept
+the reference's camelCase wire form.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from kubernetes_tpu.config.types import (
+    KubeSchedulerConfiguration,
+    KubeSchedulerProfile,
+    LeaderElectionConfiguration,
+    Plugin,
+    PluginSet,
+    Plugins,
+)
+from kubernetes_tpu.scheduler.extender import ExtenderConfig
+
+_POINT_KEYS = {
+    "queueSort": "queue_sort",
+    "preFilter": "pre_filter",
+    "filter": "filter",
+    "preScore": "pre_score",
+    "score": "score",
+    "reserve": "reserve",
+    "permit": "permit",
+    "preBind": "pre_bind",
+    "bind": "bind",
+    "postBind": "post_bind",
+    "unreserve": "unreserve",
+}
+
+
+def _plugin_set(raw: Dict[str, Any]) -> PluginSet:
+    def plugin(p: Dict[str, Any]) -> Plugin:
+        return Plugin(name=p["name"], weight=int(p.get("weight", 1)))
+
+    return PluginSet(
+        enabled=[plugin(p) for p in raw.get("enabled", [])],
+        disabled=[plugin(p) for p in raw.get("disabled", [])],
+    )
+
+
+def _plugins(raw: Optional[Dict[str, Any]]) -> Optional[Plugins]:
+    if raw is None:
+        return None
+    out = Plugins()
+    for wire_key, attr in _POINT_KEYS.items():
+        if wire_key in raw:
+            setattr(out, attr, _plugin_set(raw[wire_key]))
+    return out
+
+
+def _profile(raw: Dict[str, Any]) -> KubeSchedulerProfile:
+    plugin_config = {
+        pc["name"]: pc.get("args", {}) for pc in raw.get("pluginConfig", [])
+    }
+    return KubeSchedulerProfile(
+        scheduler_name=raw.get("schedulerName", "default-scheduler"),
+        plugins=_plugins(raw.get("plugins")),
+        plugin_config=plugin_config,
+    )
+
+
+def _extender(raw: Dict[str, Any]) -> ExtenderConfig:
+    return ExtenderConfig(
+        url_prefix=raw.get("urlPrefix", ""),
+        filter_verb=raw.get("filterVerb", ""),
+        prioritize_verb=raw.get("prioritizeVerb", ""),
+        bind_verb=raw.get("bindVerb", ""),
+        preempt_verb=raw.get("preemptVerb", ""),
+        weight=int(raw.get("weight", 1)),
+        node_cache_capable=bool(raw.get("nodeCacheCapable", False)),
+        ignorable=bool(raw.get("ignorable", False)),
+        managed_resources=[
+            r["name"] for r in raw.get("managedResources", [])
+        ],
+        http_timeout_seconds=float(raw.get("httpTimeout", 5.0)),
+    )
+
+
+def load_config_from_dict(raw: Dict[str, Any]) -> KubeSchedulerConfiguration:
+    le_raw = raw.get("leaderElection", {})
+    cfg = KubeSchedulerConfiguration(
+        profiles=[_profile(p) for p in raw.get("profiles", [])],
+        percentage_of_nodes_to_score=int(
+            raw.get("percentageOfNodesToScore", 0)
+        ),
+        pod_initial_backoff_seconds=float(
+            raw.get("podInitialBackoffSeconds", 1.0)
+        ),
+        pod_max_backoff_seconds=float(raw.get("podMaxBackoffSeconds", 10.0)),
+        leader_election=LeaderElectionConfiguration(
+            leader_elect=bool(le_raw.get("leaderElect", False)),
+            lease_duration_seconds=float(le_raw.get("leaseDuration", 15.0)),
+            renew_deadline_seconds=float(le_raw.get("renewDeadline", 10.0)),
+            retry_period_seconds=float(le_raw.get("retryPeriod", 2.0)),
+            resource_name=le_raw.get("resourceName", "kube-scheduler"),
+            resource_namespace=le_raw.get("resourceNamespace", "kube-system"),
+        ),
+        health_bind_address=raw.get("healthzBindAddress", ""),
+        metrics_bind_address=raw.get("metricsBindAddress", ""),
+        feature_gates=dict(raw.get("featureGates", {})),
+    )
+    cfg.extenders = [_extender(e) for e in raw.get("extenders", [])]
+    return cfg
+
+
+def load_config(path: str) -> KubeSchedulerConfiguration:
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    return load_config_from_dict(raw)
+
+
+class FeatureGate:
+    """component-base/featuregate/feature_gate.go: thread-safe known-gate
+    map with defaults + overrides."""
+
+    def __init__(self, defaults: Optional[Dict[str, bool]] = None) -> None:
+        self._known: Dict[str, bool] = dict(defaults or {})
+
+    def add(self, name: str, default: bool) -> None:
+        self._known.setdefault(name, default)
+
+    def set_from_map(self, overrides: Dict[str, bool]) -> None:
+        for name, value in overrides.items():
+            if name not in self._known:
+                raise ValueError(f"unknown feature gate {name!r}")
+            self._known[name] = value
+
+    def enabled(self, name: str) -> bool:
+        if name not in self._known:
+            raise ValueError(f"unknown feature gate {name!r}")
+        return self._known[name]
+
+
+# the gates the scheduler path consults (pkg/features/kube_features.go)
+DEFAULT_FEATURE_GATES = {
+    "EvenPodsSpread": True,
+    "ResourceLimitsPriorityFunction": False,
+    "NonPreemptingPriority": True,
+    "BalanceAttachedNodeVolumes": False,
+    "TPUBatchSolver": True,  # this build's fast path
+}

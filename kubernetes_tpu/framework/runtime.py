@@ -310,10 +310,14 @@ class Framework:
         wp = self.waiting_pods.get(pod.metadata.uid)
         if wp is None:
             return None
+        from kubernetes_tpu.utils import metrics
+
+        start = time.perf_counter()
         try:
             return_status = wp.wait()
         finally:
             self.waiting_pods.remove(pod.metadata.uid)
+            metrics.permit_wait_duration.observe(time.perf_counter() - start)
         if not return_status.is_success():
             return return_status
         return None

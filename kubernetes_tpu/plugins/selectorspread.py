@@ -142,6 +142,8 @@ class DefaultPodTopologySpread(Plugin):
     def pre_score(
         self, state: CycleState, pod: Pod, nodes: List[NodeInfo]
     ) -> Optional[Status]:
+        if self._skip(pod):
+            return None  # score/normalize will ignore it anyway
         informers = getattr(self.handle, "informers", None)
         state.write(PRE_SCORE_SELECTOR_KEY, default_selector(pod, informers))
         return None
@@ -286,11 +288,23 @@ class ServiceAffinity(Plugin):
         return out
 
     def pre_filter(self, state: CycleState, pod: Pod) -> Optional[Status]:
+        """service_affinity.go:108 createPreFilterState: matching pods are
+        same-namespace pods carrying ALL of the incoming pod's labels (the
+        pod's own labels as selector) -- the same predicate AddPod uses, so
+        incremental updates equal a recompute."""
         if not self.affinity_labels:
             return None
+        snapshot = state.read("__snapshot__")
+        own = pod.metadata.labels
+        matching = [
+            p
+            for p in snapshot.list_pods()
+            if p.metadata.namespace == pod.metadata.namespace
+            and own
+            and all(p.metadata.labels.get(k) == v for k, v in own.items())
+        ]
         state.write(
-            PRE_FILTER_SERVICE_AFFINITY_KEY,
-            _ServiceAffinityState(self._service_mate_pods(state, pod)),
+            PRE_FILTER_SERVICE_AFFINITY_KEY, _ServiceAffinityState(matching)
         )
         return None
 
@@ -319,7 +333,8 @@ class ServiceAffinity(Plugin):
                     PRE_FILTER_SERVICE_AFFINITY_KEY
                 )
             except KeyError:
-                s = _ServiceAffinityState(self._service_mate_pods(state, pod))
+                self.pre_filter(state, pod)
+                s = state.read(PRE_FILTER_SERVICE_AFFINITY_KEY)
             snapshot = state.read("__snapshot__")
             scheduled = [
                 p for p in s.matching_pods if p.spec.node_name

@@ -316,10 +316,19 @@ class VolumeBinder:
         )
 
     def _find_matching_pv(
-        self, pvc: PersistentVolumeClaim, node_info: NodeInfo
+        self,
+        pvc: PersistentVolumeClaim,
+        node_info: NodeInfo,
+        reserved: Optional[set] = None,
     ) -> Optional[PersistentVolume]:
+        """``reserved`` carries PV names already matched to earlier claims
+        of the same pod in this call -- the assume-cache role of the
+        reference binder (scheduler_binder.go:320), preventing one PV from
+        satisfying two claims."""
         best = None
         for pv in self.listers.list_pvs():
+            if reserved and pv.metadata.name in reserved:
+                continue
             if pv.claim_ref_name and not pv.is_bound_to(
                 pvc.metadata.namespace, pvc.metadata.name
             ):
@@ -338,6 +347,7 @@ class VolumeBinder:
         self, pod: Pod, node_info: NodeInfo
     ) -> Optional[Status]:
         """FindPodVolumes (scheduler_binder.go:235)."""
+        reserved: set = set()
         for v, pvc in self._claims(pod):
             if pvc is None:
                 return Status.unschedulable_and_unresolvable(
@@ -360,7 +370,9 @@ class VolumeBinder:
                 return Status.unschedulable_and_unresolvable(
                     ERR_REASON_UNBOUND_IMMEDIATE
                 )
-            if self._find_matching_pv(pvc, node_info) is not None:
+            match = self._find_matching_pv(pvc, node_info, reserved)
+            if match is not None:
+                reserved.add(match.metadata.name)
                 continue
             if sc.provisioner and sc.provisioner != "kubernetes.io/no-provisioner":
                 continue  # dynamically provisionable on this node
@@ -371,10 +383,11 @@ class VolumeBinder:
         """AssumePodVolumes+BindPodVolumes collapsed: bind matched PVs."""
         if self.client is None:
             return None
+        reserved: set = set()
         for v, pvc in self._claims(pod):
             if pvc is None or pvc.volume_name:
                 continue
-            pv = self._find_matching_pv(pvc, node_info)
+            pv = self._find_matching_pv(pvc, node_info, reserved)
             if pv is None:
                 sc = self.listers.storage_class(pvc.storage_class_name)
                 if sc is not None and sc.provisioner and \
@@ -385,6 +398,7 @@ class VolumeBinder:
                 )
             # guaranteed updates: never mutate the lister's shared objects
             # in place (the store's copy-on-write contract)
+            reserved.add(pv.metadata.name)
             pv_name = pv.metadata.name
             ns, claim = pvc.metadata.namespace, pvc.metadata.name
 

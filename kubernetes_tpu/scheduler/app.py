@@ -1,0 +1,140 @@
+"""The process shell: config -> wired scheduler + serving + HA.
+
+Reference: /root/reference/cmd/kube-scheduler/app/server.go (Run :164:
+event broadcaster, healthz :203-214, metrics :220, informer start, leader
+election :241-247, sched.Run) and options loading.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.config.types import KubeSchedulerConfiguration
+from kubernetes_tpu.scheduler.debugger import CacheDebugger
+from kubernetes_tpu.scheduler.leaderelection import LeaderElector
+from kubernetes_tpu.scheduler.scheduler import Scheduler, new_scheduler
+from kubernetes_tpu.utils import metrics
+
+logger = logging.getLogger(__name__)
+
+
+class _OpsHandler(BaseHTTPRequestHandler):
+    app: "SchedulerApp"
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _reply(self, code: int, body: str, ctype: str = "text/plain") -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._reply(200, "ok")
+        elif self.path == "/metrics":
+            # refresh state gauges at scrape time (pending_pods,
+            # scheduler_cache_size -- metrics.go:155, :230)
+            for queue_name, n in self.app.sched.queue.num_pending().items():
+                metrics.pending_pods.set(n, queue=queue_name)
+            metrics.cache_size.set(self.app.sched.cache.node_count(), type="nodes")
+            metrics.cache_size.set(self.app.sched.cache.pod_count(), type="pods")
+            self._reply(
+                200, metrics.registry.expose(), "text/plain; version=0.0.4"
+            )
+        elif self.path == "/debug/cache":
+            self._reply(200, self.app.debugger.dumper.dump_all())
+        elif self.path == "/debug/comparer":
+            self._reply(
+                200, json.dumps(self.app.debugger.comparer.compare(), indent=1)
+            )
+        else:
+            self._reply(404, "not found")
+
+
+class SchedulerApp:
+    """One scheduler process: serving + (optional) leader election around
+    the scheduling loop."""
+
+    def __init__(
+        self,
+        config: Optional[KubeSchedulerConfiguration] = None,
+        server: Optional[APIServer] = None,
+        batch: bool = True,
+    ) -> None:
+        self.config = config or KubeSchedulerConfiguration()
+        self.server = server or APIServer()
+        self.client = Client(self.server)
+        self.informers = InformerFactory(self.server)
+        self.identity = f"scheduler-{uuid.uuid4().hex[:8]}"
+        self.sched: Scheduler = new_scheduler(
+            self.client,
+            self.informers,
+            profiles=self.config.profiles or None,
+            percentage_of_nodes_to_score=(
+                self.config.percentage_of_nodes_to_score
+            ),
+            batch=batch,
+            extenders=getattr(self.config, "extenders", None),
+        )
+        self.debugger = CacheDebugger(
+            self.client,
+            self.sched.cache,
+            self.sched.queue,
+            tensor_cache=getattr(self.sched, "tensor_cache", None),
+            snapshot=self.sched.algorithm.snapshot,
+        )
+        self.elector: Optional[LeaderElector] = None
+        self._http: Optional[ThreadingHTTPServer] = None
+        self._threads = []
+
+    # -- serving (server.go:203-224) ----------------------------------------
+
+    def start_serving(self) -> Tuple[str, int]:
+        handler = type("Handler", (_OpsHandler,), {"app": self})
+        addr = self.config.health_bind_address or "127.0.0.1:0"
+        host, _, port = addr.partition(":")
+        self._http = ThreadingHTTPServer((host, int(port or 0)), handler)
+        t = threading.Thread(target=self._http.serve_forever, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self._http.server_address[:2]
+
+    # -- run (server.go:164) -------------------------------------------------
+
+    def start(self) -> None:
+        self.informers.start()
+        self.informers.wait_for_cache_sync()
+        if self.config.leader_election.leader_elect:
+            self.elector = LeaderElector(
+                self.client,
+                self.config.leader_election,
+                self.identity,
+                on_started_leading=lambda: self.sched.run(),
+                on_stopped_leading=self.sched.stop,
+            )
+            t = threading.Thread(target=self.elector.run, daemon=True)
+            t.start()
+            self._threads.append(t)
+        else:
+            self.sched.start()
+
+    def stop(self) -> None:
+        if self.elector is not None:
+            self.elector.stop()
+            self.elector.release()
+        self.sched.stop()
+        self.informers.stop()
+        if self._http is not None:
+            self._http.shutdown()

@@ -34,6 +34,7 @@ from kubernetes_tpu.ops.host_masks import static_mask
 from kubernetes_tpu.scheduler.generic import SNAPSHOT_STATE_KEY
 from kubernetes_tpu.scheduler.scheduler import Scheduler
 from kubernetes_tpu.tensors import NodeTensorCache, pack_pod_batch
+from kubernetes_tpu.utils import metrics
 
 logger = logging.getLogger(__name__)
 
@@ -142,10 +143,13 @@ class BatchScheduler(Scheduler):
                 self.batches_solved += 1
                 solver_infos.clear()
 
+        extenders = self.algorithm.extenders
         for pi in batch_infos:
             if self._skip_pod_schedule(pi.pod):
                 continue
-            if solver_supported(pi.pod):
+            if solver_supported(pi.pod) and not any(
+                e.is_interested(pi.pod) for e in extenders
+            ):
                 solver_infos.append(pi)
             else:
                 flush()
@@ -209,6 +213,7 @@ class BatchScheduler(Scheduler):
         sm[:b] = smask[order]
         active[:b] = True
 
+        solve_timer = metrics.SinceTimer(metrics.batch_solve_duration)
         assignments, _, _ = greedy_assign(
             jnp.asarray(nt.allocatable),
             jnp.asarray(node_requested),
@@ -221,6 +226,8 @@ class BatchScheduler(Scheduler):
             config=self.solver_config,
         )
         assignments = np.asarray(assignments)
+        solve_timer.observe()
+        metrics.batch_size.observe(b)
 
         num_nodes = nt.num_nodes
         for k in range(b):
@@ -233,6 +240,7 @@ class BatchScheduler(Scheduler):
             state = CycleState()
             state.write(SNAPSHOT_STATE_KEY, snapshot)
             if choice == NO_NODE:
+                metrics.schedule_attempts.inc(result="unschedulable")
                 # populate PreFilter state so preemption's victim
                 # simulation can run the full filter pipeline (the
                 # sequential path gets this from algorithm.schedule)

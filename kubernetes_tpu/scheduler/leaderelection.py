@@ -1,0 +1,157 @@
+"""Lease-based leader election (active/passive scheduler HA).
+
+Reference: /root/reference/staging/src/k8s.io/client-go/tools/
+leaderelection/leaderelection.go (Run :197, acquire :244, renew :258) with
+the LeaseLock resource lock. Semantics kept: a candidate acquires when the
+lease is unheld or expired; the holder renews every retry period and MUST
+abdicate (callback + return) when it cannot renew within the renew
+deadline -- lost lease means process restart in the reference
+(server.go:247 klog.Fatalf); all scheduler state is soft and rebuilt from
+informers.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from kubernetes_tpu.api.types import Lease, ObjectMeta
+from kubernetes_tpu.config.types import LeaderElectionConfiguration
+
+logger = logging.getLogger(__name__)
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        client,
+        config: LeaderElectionConfiguration,
+        identity: str,
+        on_started_leading: Callable[[], None],
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.client = client
+        self.config = config
+        self.identity = identity
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.clock = clock
+        self._stop = threading.Event()
+        self.is_leader = False
+
+    # -- lock primitives ----------------------------------------------------
+
+    def _get_or_create(self) -> Lease:
+        server = self.client.server
+        try:
+            return server.get(
+                "Lease", self.config.resource_namespace, self.config.resource_name
+            )
+        except KeyError:
+            lease = Lease(
+                metadata=ObjectMeta(
+                    name=self.config.resource_name,
+                    namespace=self.config.resource_namespace,
+                )
+            )
+            try:
+                return server.create(lease)
+            except ValueError:  # lost the create race
+                return server.get(
+                    "Lease",
+                    self.config.resource_namespace,
+                    self.config.resource_name,
+                )
+
+    def _try_acquire_or_renew(self) -> bool:
+        """One CAS round (leaderelection.go:317 tryAcquireOrRenew). The
+        holder/expiry check runs INSIDE the atomic update so two candidates
+        can never both seize the lease, and expiry honors the duration
+        advertised in the lease record (observedRecord.LeaseDurationSeconds),
+        not the challenger's local config."""
+        server = self.client.server
+        now = self.clock()
+        self._get_or_create()
+
+        class _Held(Exception):
+            pass
+
+        def mutate(obj: Lease) -> None:
+            expired = obj.renew_time + obj.lease_duration_seconds <= now
+            if obj.holder_identity not in ("", self.identity) and not expired:
+                raise _Held()
+            if obj.holder_identity != self.identity:
+                obj.lease_transitions += 1
+                obj.acquire_time = now
+            obj.holder_identity = self.identity
+            obj.lease_duration_seconds = self.config.lease_duration_seconds
+            obj.renew_time = now
+
+        try:
+            server.guaranteed_update(
+                "Lease",
+                self.config.resource_namespace,
+                self.config.resource_name,
+                mutate,
+            )
+            return True
+        except _Held:
+            return False
+        except Exception:
+            logger.exception("lease update failed")
+            return False
+
+    # -- run loop -----------------------------------------------------------
+
+    def run(self) -> None:
+        """Blocks: acquire -> lead (renew loop) -> abdicate on failure."""
+        while not self._stop.is_set():
+            if not self._try_acquire_or_renew():
+                self._stop.wait(self.config.retry_period_seconds)
+                continue
+            # we are the leader
+            self.is_leader = True
+            logger.info("became leader: %s", self.identity)
+            lead_thread = threading.Thread(
+                target=self.on_started_leading, daemon=True
+            )
+            lead_thread.start()
+            deadline = self.clock() + self.config.renew_deadline_seconds
+            while not self._stop.is_set():
+                if self._try_acquire_or_renew():
+                    deadline = self.clock() + self.config.renew_deadline_seconds
+                elif self.clock() >= deadline:
+                    break  # failed to renew within the deadline: abdicate
+                self._stop.wait(self.config.retry_period_seconds)
+            self.is_leader = False
+            if not self._stop.is_set():
+                logger.error("lost leader lease: %s", self.identity)
+            if self.on_stopped_leading is not None:
+                self.on_stopped_leading()
+            return  # reference fatals here; caller decides restart policy
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def release(self) -> None:
+        """Voluntarily give up the lease (leaderelection.go release)."""
+        if not self.is_leader:
+            return
+
+        def mutate(obj: Lease) -> None:
+            obj.holder_identity = ""
+            obj.renew_time = 0.0
+
+        try:
+            self.client.server.guaranteed_update(
+                "Lease",
+                self.config.resource_namespace,
+                self.config.resource_name,
+                mutate,
+            )
+        except Exception:
+            logger.exception("releasing lease")
+        self.is_leader = False

@@ -26,6 +26,7 @@ from kubernetes_tpu.framework.interface import (
     FitError,
     StatusCode,
 )
+from kubernetes_tpu.utils import metrics
 
 logger = logging.getLogger(__name__)
 
@@ -259,6 +260,16 @@ class Preemptor:
             )
             if fits:
                 nodes_to_victims[ni.node_name] = Victims(victims, num_violating)
+        # extenders supporting preemption narrow the candidates
+        # (generic_scheduler.go:328 processPreemptionWithExtenders)
+        for extender in getattr(self.algorithm, "extenders", []):
+            if not nodes_to_victims:
+                break
+            if getattr(extender, "supports_preemption", lambda: False)() and \
+                    extender.is_interested(pod):
+                nodes_to_victims = extender.process_preemption(
+                    pod, nodes_to_victims
+                )
         node_name = pick_one_node_for_preemption(nodes_to_victims)
         if node_name is None:
             return "", [], []
@@ -289,7 +300,9 @@ class Preemptor:
         node_name, victims, to_clear = self.find_preemption(
             prof, state, pod, fit_err
         )
+        metrics.preemption_attempts.inc()
         if node_name:
+            metrics.preemption_victims.observe(len(victims))
             self.queue.update_nominated_pod_for_node(pod, node_name)
             if self.client is not None:
                 try:

@@ -32,6 +32,7 @@ from kubernetes_tpu.plugins import new_in_tree_registry
 from kubernetes_tpu.queue.scheduling_queue import PriorityQueue
 from kubernetes_tpu.scheduler.generic import GenericScheduler
 from kubernetes_tpu.scheduler.provider import default_plugins
+from kubernetes_tpu.utils import metrics
 
 logger = logging.getLogger(__name__)
 
@@ -203,19 +204,25 @@ class Scheduler:
             return
 
         state = CycleState()
+        state.write("__cycle_start__", time.perf_counter())
+        timer = metrics.SinceTimer(metrics.scheduling_algorithm_duration)
         try:
             result = self.algorithm.schedule(prof, state, pod)
         except FitError as fit_err:
+            metrics.schedule_attempts.inc(result="unschedulable")
             self.handle_fit_error(
                 prof, state, pod_info, fit_err, pod_scheduling_cycle
             )
             return
         except Exception as e:
+            metrics.schedule_attempts.inc(result="error")
             logger.exception("scheduling %s failed", pod.key())
             self.record_scheduling_failure(
                 prof, pod_info, str(e), "SchedulerError", "", pod_scheduling_cycle
             )
             return
+        finally:
+            timer.observe()
         self.finish_schedule(
             prof, state, pod_info, result.suggested_host, pod_scheduling_cycle
         )
@@ -332,8 +339,11 @@ class Scheduler:
             )
             return
 
+        bind_timer = metrics.SinceTimer(metrics.binding_duration)
         status = self.bind(prof, state, assumed, host)
+        bind_timer.observe()
         if status is not None and not status.is_success():
+            metrics.schedule_attempts.inc(result="error")
             self._forget(assumed)
             prof.run_unreserve_plugins(state, assumed, host)
             self.record_scheduling_failure(
@@ -342,6 +352,22 @@ class Scheduler:
             )
             return
         prof.run_post_bind_plugins(state, assumed, host)
+        metrics.schedule_attempts.inc(result="scheduled")
+        metrics.pod_scheduling_attempts.observe(pod_info.attempts)
+        # PodInfo timestamps come from the queue's monotonic clock
+        now = time.monotonic()
+        if pod_info.initial_attempt_timestamp:
+            metrics.pod_scheduling_duration.observe(
+                max(0.0, now - pod_info.initial_attempt_timestamp)
+            )
+        try:
+            cycle_start = state.read("__cycle_start__")
+        except KeyError:
+            pass
+        else:
+            metrics.e2e_scheduling_duration.observe(
+                max(0.0, time.perf_counter() - cycle_start)
+            )
 
     def _forget(self, assumed: Pod) -> None:
         try:
@@ -390,6 +416,7 @@ def new_scheduler(
     batch: bool = False,
     max_batch: int = 256,
     solver_config=None,
+    extenders: Optional[List] = None,
 ) -> Scheduler:
     """Build a fully wired scheduler (reference scheduler.go:223 New +
     factory.go create). ``batch=True`` selects the TPU batch-solver loop
@@ -404,12 +431,25 @@ def new_scheduler(
     snapshot = Snapshot()
 
     frameworks: Dict[str, Framework] = {}
+    built_extenders = []
+    for ext in extenders or []:
+        if hasattr(ext, "url_prefix"):  # ExtenderConfig -> HTTPExtender
+            from kubernetes_tpu.scheduler.extender import HTTPExtender
+
+            built_extenders.append(HTTPExtender(ext))
+        else:
+            built_extenders.append(ext)
+
     algorithm = GenericScheduler(
         cache,
         snapshot,
         percentage_of_nodes_to_score=percentage_of_nodes_to_score,
         rng=rng,
+        extenders=built_extenders,
     )
+    from kubernetes_tpu.scheduler.metrics_recorder import MetricsRecorder
+
+    recorder = MetricsRecorder()
     for profile_cfg in profiles:
         plugins = default_plugins()
         # prune defaults to registered plugins so the provider list can name
@@ -423,6 +463,7 @@ def new_scheduler(
             client=client,
             snapshot_provider=lambda: snapshot,
             informers=informer_factory,
+            metrics_recorder=recorder,
         )
         frameworks[profile_cfg.scheduler_name] = fw
 

@@ -1,0 +1,1 @@
+"""Shared utilities: metrics, tracing, clock helpers."""

@@ -1,0 +1,256 @@
+"""Prometheus-style metrics with the reference's metric names.
+
+Reference: /root/reference/pkg/scheduler/metrics/metrics.go (metric set
+:54-:230) and staging/src/k8s.io/component-base/metrics (registry +
+text exposition). The names below are kept identical so dashboards and
+the perf harness scrape unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+_DEF_BUCKETS = (
+    0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.5, 1, 2, 5, 10,
+)
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_labels(key: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Counter:
+    def __init__(self, name: str, help: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Gauge:
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        label_names: Sequence[str] = (),
+        fn: Optional[Callable[[], float]] = None,
+    ):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self.fn = fn  # callback gauge
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = value
+
+    def value(self, **labels: str) -> float:
+        if self.fn is not None:
+            return self.fn()
+        return self._values.get(_label_key(labels), 0.0)
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        if self.fn is not None:
+            out.append(f"{self.name} {self.fn()}")
+            return out
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Histogram:
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        label_names: Sequence[str] = (),
+        buckets: Sequence[float] = _DEF_BUCKETS,
+    ):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._totals: Dict[Tuple, int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, **labels: str) -> int:
+        return self._totals.get(_label_key(labels), 0)
+
+    def sum(self, **labels: str) -> float:
+        return self._sums.get(_label_key(labels), 0.0)
+
+    def collect(self) -> List[str]:
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        with self._lock:
+            for key in sorted(self._totals):
+                for i, b in enumerate(self.buckets):
+                    out.append(
+                        f"{self.name}_bucket"
+                        f"{_fmt_labels(key, f'le=\"{b}\"')} "
+                        f"{self._counts[key][i]}"
+                    )
+                out.append(
+                    f"{self.name}_bucket{_fmt_labels(key, 'le=\"+Inf\"')} "
+                    f"{self._totals[key]}"
+                )
+                out.append(f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]}")
+                out.append(f"{self.name}_count{_fmt_labels(key)} {self._totals[key]}")
+        return out
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: List = []
+        self._lock = threading.Lock()
+
+    def register(self, metric):
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            lines.extend(m.collect())
+        return "\n".join(lines) + "\n"
+
+
+# -- the scheduler metric set (metrics.go names, verbatim) -------------------
+
+registry = Registry()
+
+schedule_attempts = registry.register(Counter(
+    "scheduler_schedule_attempts_total",
+    "Number of attempts to schedule pods, by result.",
+    ("result",),
+))
+e2e_scheduling_duration = registry.register(Histogram(
+    "scheduler_e2e_scheduling_duration_seconds",
+    "E2e scheduling latency (scheduling algorithm + binding).",
+))
+scheduling_algorithm_duration = registry.register(Histogram(
+    "scheduler_scheduling_algorithm_duration_seconds",
+    "Scheduling algorithm latency.",
+))
+binding_duration = registry.register(Histogram(
+    "scheduler_binding_duration_seconds",
+    "Binding latency.",
+))
+preemption_victims = registry.register(Histogram(
+    "scheduler_pod_preemption_victims",
+    "Number of selected preemption victims.",
+    buckets=(1, 2, 4, 8, 16, 32, 64),
+))
+preemption_attempts = registry.register(Counter(
+    "scheduler_total_preemption_attempts",
+    "Total preemption attempts in the cluster.",
+))
+pending_pods = registry.register(Gauge(
+    "scheduler_pending_pods",
+    "Number of pending pods by queue.",
+    ("queue",),
+))
+pod_scheduling_duration = registry.register(Histogram(
+    "scheduler_pod_scheduling_duration_seconds",
+    "E2e latency for a pod being scheduled, from first attempt.",
+))
+pod_scheduling_attempts = registry.register(Histogram(
+    "scheduler_pod_scheduling_attempts",
+    "Number of attempts to successfully schedule a pod.",
+    buckets=(1, 2, 4, 8, 16),
+))
+framework_extension_point_duration = registry.register(Histogram(
+    "scheduler_framework_extension_point_duration_seconds",
+    "Latency for running all plugins of a specific extension point.",
+    ("extension_point", "status"),
+))
+plugin_execution_duration = registry.register(Histogram(
+    "scheduler_plugin_execution_duration_seconds",
+    "Duration for running a plugin at a specific extension point.",
+    ("plugin", "extension_point", "status"),
+))
+queue_incoming_pods = registry.register(Counter(
+    "scheduler_queue_incoming_pods_total",
+    "Number of pods added to scheduling queues by event and queue type.",
+    ("queue", "event"),
+))
+permit_wait_duration = registry.register(Histogram(
+    "scheduler_permit_wait_duration_seconds",
+    "Duration of waiting on permit.",
+))
+cache_size = registry.register(Gauge(
+    "scheduler_scheduler_cache_size",
+    "Number of nodes, pods, and assumed pods in the scheduler cache.",
+    ("type",),
+))
+# TPU-path additions (new names, not replacements)
+batch_solve_duration = registry.register(Histogram(
+    "scheduler_tpu_batch_solve_duration_seconds",
+    "Device solve latency per batch (pack + solve + readback).",
+))
+batch_size = registry.register(Histogram(
+    "scheduler_tpu_batch_size",
+    "Pods per device-solved batch.",
+    buckets=(1, 8, 32, 64, 128, 256, 512, 1024),
+))
+
+
+class SinceTimer:
+    """Tiny helper: observe elapsed seconds into a histogram."""
+
+    def __init__(self, hist: Histogram, **labels: str) -> None:
+        self.hist = hist
+        self.labels = labels
+        self.start = time.perf_counter()
+
+    def observe(self, **extra: str) -> float:
+        elapsed = time.perf_counter() - self.start
+        self.hist.observe(elapsed, **{**self.labels, **extra})
+        return elapsed

@@ -1,0 +1,172 @@
+"""HTTP extender tests against a real in-process HTTP server
+(reference pattern: test/integration/scheduler/extender_test.go)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.scheduler.extender import ExtenderConfig, HTTPExtender
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+class _ExtenderHandler(BaseHTTPRequestHandler):
+    bindings = []
+
+    def log_message(self, *a):  # silence
+        pass
+
+    def do_POST(self):
+        length = int(self.headers["Content-Length"])
+        args = json.loads(self.rfile.read(length))
+        cache_capable = "nodenames" in args
+        if cache_capable:
+            names_in = args.get("nodenames", [])
+        else:
+            names_in = [
+                n["metadata"]["name"]
+                for n in args.get("nodes", {}).get("items", [])
+            ]
+        if self.path.endswith("/filter"):
+            # reject any node literally named "forbidden"
+            names = [n for n in names_in if n != "forbidden"]
+            failed = {n: "extender says no" for n in names_in
+                      if n == "forbidden"}
+            if cache_capable:
+                out = {"nodeNames": names, "failedNodes": failed}
+            else:
+                out = {
+                    "nodes": {"items": [{"metadata": {"name": n}}
+                                        for n in names]},
+                    "failedNodes": failed,
+                }
+        elif self.path.endswith("/prioritize"):
+            # strongly prefer node "preferred"
+            out = [
+                {"host": n, "score": 10 if n == "preferred" else 0}
+                for n in names_in
+            ]
+        elif self.path.endswith("/bind"):
+            _ExtenderHandler.bindings.append(args)
+            out = {}
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture
+def extender_server():
+    _ExtenderHandler.bindings = []
+    httpd = HTTPServer(("127.0.0.1", 0), _ExtenderHandler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_port}"
+    httpd.shutdown()
+
+
+class TestHTTPExtender:
+    def test_filter_and_prioritize(self, extender_server):
+        from kubernetes_tpu.cache.node_info import NodeInfo
+
+        for cache_capable in (True, False):
+            ext = HTTPExtender(ExtenderConfig(
+                url_prefix=extender_server,
+                filter_verb="filter",
+                prioritize_verb="prioritize",
+                weight=2,
+                node_cache_capable=cache_capable,
+            ))
+            nodes = [
+                NodeInfo(make_node("forbidden").obj()),
+                NodeInfo(make_node("preferred").obj()),
+            ]
+            pod = make_pod("p").obj()
+            feasible, failed = ext.filter(pod, nodes)
+            assert [ni.node_name for ni in feasible] == ["preferred"], (
+                cache_capable
+            )
+            assert "forbidden" in failed
+            scores = ext.prioritize(pod, nodes)
+            assert scores["preferred"] == 20  # weighted x2
+
+    def test_managed_resources_interest(self, extender_server):
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix=extender_server,
+            filter_verb="filter",
+            managed_resources=["example.com/fpga"],
+        ))
+        plain = make_pod("plain").container(cpu="1").obj()
+        special = make_pod("special").container(
+            cpu="1", **{"example_com__fpga": 1}
+        ).obj()
+        assert not ext.is_interested(plain)
+        assert ext.is_interested(special)
+
+    def test_ignorable_extender_error_passthrough(self):
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix="http://127.0.0.1:1",  # nothing listening
+            filter_verb="filter",
+            ignorable=True,
+        ))
+        from kubernetes_tpu.cache.node_info import NodeInfo
+
+        nodes = [NodeInfo(make_node("n").obj())]
+        feasible, failed = ext.filter(make_pod("p").obj(), nodes)
+        assert len(feasible) == 1 and not failed
+
+    def test_non_ignorable_extender_error_raises(self):
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix="http://127.0.0.1:1",
+            filter_verb="filter",
+        ))
+        from kubernetes_tpu.cache.node_info import NodeInfo
+
+        with pytest.raises(Exception):
+            ext.filter(make_pod("p").obj(), [NodeInfo(make_node("n").obj())])
+
+
+class TestEndToEndWithExtender:
+    def test_extender_steers_scheduling_and_binds(self, extender_server):
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        cfg = ExtenderConfig(
+            url_prefix=extender_server,
+            filter_verb="filter",
+            prioritize_verb="prioritize",
+            bind_verb="bind",
+            weight=100,
+        )
+        sched = new_scheduler(client, informers, extenders=[cfg])
+        for name in ("forbidden", "preferred", "other"):
+            client.create_node(
+                make_node(name).capacity(cpu="8", memory="16Gi").obj()
+            )
+        informers.start()
+        informers.wait_for_cache_sync()
+        client.create_pod(make_pod("p").container(cpu="1").obj())
+        sched.start()
+        deadline = time.time() + 10
+        bound = False
+        while time.time() < deadline:
+            if _ExtenderHandler.bindings:
+                bound = True
+                break
+            time.sleep(0.05)
+        sched.stop()
+        informers.stop()
+        assert bound, "extender bind verb never called"
+        assert _ExtenderHandler.bindings[0]["node"] == "preferred"

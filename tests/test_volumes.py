@@ -218,6 +218,34 @@ class TestVolumeBinding:
         assert pvc.phase == "Bound"
 
 
+class TestPVReservation:
+    def test_one_pv_cannot_satisfy_two_claims(self, env):
+        server, client, informers, handle = env
+        client.create(StorageClass(
+            metadata=_cluster_meta("sc"),
+            provisioner="kubernetes.io/no-provisioner",
+            volume_binding_mode="WaitForFirstConsumer",
+        ))
+        for name in ("claim-a", "claim-b"):
+            client.create(PersistentVolumeClaim(
+                metadata=ObjectMeta(name=name, namespace="default"),
+                storage_class_name="sc", requested_bytes=1 << 30,
+            ))
+        client.create(PersistentVolume(
+            metadata=_cluster_meta("only-pv"),
+            storage_class_name="sc", capacity_bytes=4 << 30,
+        ))
+        for acc in ("persistent_volume_claims", "persistent_volumes",
+                    "storage_classes"):
+            getattr(informers, acc)()
+        _pump(informers)
+        pl = volumes.VolumeBinding(handle)
+        pod = make_pod("p").pvc("claim-a").pvc("claim-b").obj()
+        status = pl.filter(CycleState(), pod, NodeInfo(make_node("n").obj()))
+        assert status is not None  # only one PV: second claim can't bind
+        assert status.code == StatusCode.UNSCHEDULABLE
+
+
 class TestBoundPVNodeAffinity:
     def test_bound_claim_respects_pv_affinity(self, env):
         server, client, informers, handle = env
