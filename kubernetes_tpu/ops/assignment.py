@@ -119,6 +119,149 @@ def greedy_assign(
     return assignments, req_out, nzr_out
 
 
+@jax.jit
+def greedy_assign_scored(
+    allocatable: jnp.ndarray,  # [N, R] int32
+    requested: jnp.ndarray,  # [N, R] int32
+    valid: jnp.ndarray,  # [N] bool
+    pod_requests: jnp.ndarray,  # [B, R] int32, solve order
+    static_mask: jnp.ndarray,  # [B, N] bool
+    active: jnp.ndarray,  # [B] bool
+    score_matrix: jnp.ndarray,  # [B, N] float32 precomputed (e.g. Sinkhorn)
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Capacity-replay commit scan over a PRECOMPUTED score matrix (the
+    Sinkhorn mode): feasibility is re-checked exactly per step, only the
+    ranking comes from the matrix. Returns (assignment, requested')."""
+    n = allocatable.shape[0]
+    node_iota = jnp.arange(n, dtype=jnp.int32)
+
+    def step(carry, inputs):
+        req_state = carry
+        pod_req, smask, is_active, row = inputs
+        free = allocatable - req_state
+        fits = ((pod_req[None, :] <= free) | (pod_req[None, :] == 0)).all(
+            axis=-1
+        )
+        feasible = fits & smask & valid
+        score = jnp.where(feasible, row, -jnp.inf)
+        choice = jnp.argmax(score).astype(jnp.int32)
+        placed = feasible.any() & is_active
+        assignment = jnp.where(placed, choice, NO_NODE)
+        chosen = (node_iota == choice) & placed
+        req_state = req_state + chosen[:, None] * pod_req[None, :]
+        return req_state, assignment
+
+    req_out, assignments = jax.lax.scan(
+        step, requested, (pod_requests, static_mask, active, score_matrix)
+    )
+    return assignments, req_out
+
+
+@partial(jax.jit, static_argnames=("config",))
+def greedy_assign_spread(
+    allocatable: jnp.ndarray,  # [N, R] int32
+    requested: jnp.ndarray,  # [N, R] int32
+    nzr: jnp.ndarray,  # [N, 2] int32
+    valid: jnp.ndarray,  # [N] bool
+    pod_requests: jnp.ndarray,  # [B, R] int32, solve order
+    pod_nzr: jnp.ndarray,  # [B, 2] int32
+    static_mask: jnp.ndarray,  # [B, N] bool
+    active: jnp.ndarray,  # [B] bool
+    group_counts: jnp.ndarray,  # [G, V] int32 initial spread counts
+    value_valid: jnp.ndarray,  # [G, V] bool
+    node_value: jnp.ndarray,  # [G, N] int32 (-1 = ineligible)
+    pod_groups: jnp.ndarray,  # [B, C] int32 (-1 pad)
+    pod_max_skew: jnp.ndarray,  # [B, C] int32
+    pod_self: jnp.ndarray,  # [B, C] int32
+    pod_match: jnp.ndarray,  # [B, G] int32
+    config: GreedyConfig = GreedyConfig(),
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """greedy_assign + topology-spread filtering with within-batch count
+    replay (ops/topology.py). Returns (assignment, requested', nzr',
+    group_counts')."""
+    caps = allocatable[:, :2]
+    n = allocatable.shape[0]
+    g_count = group_counts.shape[0]
+    node_iota = jnp.arange(n, dtype=jnp.int32)
+    group_iota = jnp.arange(g_count, dtype=jnp.int32)
+    big = jnp.int32(1 << 20)
+
+    def step(carry, inputs):
+        req_state, nzr_state, counts = carry
+        pod_req, p_nzr, smask, is_active, groups, skews, selfs, match = inputs
+
+        free = allocatable - req_state
+        fits = ((pod_req[None, :] <= free) | (pod_req[None, :] == 0)).all(
+            axis=-1
+        )
+        feasible = fits & smask & valid
+
+        # spread check per constraint slot (filtering.go:322 skew rule)
+        def one_constraint(c):
+            g = groups[c]
+            safe_g = jnp.maximum(g, 0)
+            counts_g = counts[safe_g]  # [V]
+            min_v = jnp.min(
+                jnp.where(value_valid[safe_g], counts_g, big)
+            )
+            vals = node_value[safe_g]  # [N]
+            node_count = counts_g[jnp.clip(vals, 0, counts_g.shape[0] - 1)]
+            ok = (vals >= 0) & (
+                node_count + selfs[c] - min_v <= skews[c]
+            )
+            return jnp.where(g >= 0, ok, jnp.ones_like(ok))
+
+        spread_ok = jax.vmap(one_constraint)(
+            jnp.arange(groups.shape[0])
+        ).all(axis=0)
+        feasible = feasible & spread_ok
+
+        score = jnp.zeros((n,), dtype=jnp.float32)
+        if config.least_allocated_weight:
+            score += config.least_allocated_weight * least_allocated_score(
+                caps, nzr_state, p_nzr[None, :]
+            )[0]
+        if config.balanced_allocation_weight:
+            score += (
+                config.balanced_allocation_weight
+                * balanced_allocation_score(caps, nzr_state, p_nzr[None, :])[0]
+            )
+        if config.most_allocated_weight:
+            score += config.most_allocated_weight * most_allocated_score(
+                caps, nzr_state, p_nzr[None, :]
+            )[0]
+
+        score = jnp.where(feasible, score, -jnp.inf)
+        choice = jnp.argmax(score).astype(jnp.int32)
+        placed = feasible.any() & is_active
+        assignment = jnp.where(placed, choice, NO_NODE)
+
+        chosen = (node_iota == choice) & placed
+        req_state = req_state + chosen[:, None] * pod_req[None, :]
+        nzr_state = nzr_state + chosen[:, None] * p_nzr[None, :]
+
+        # count replay: the placed pod bumps every group it matches
+        # (updateWithPod generalized to the batch)
+        vals_at_choice = node_value[:, choice]  # [G]
+        bump = (
+            placed & (vals_at_choice >= 0) & (match > 0)
+        ).astype(jnp.int32)
+        counts = counts.at[
+            group_iota, jnp.clip(vals_at_choice, 0, counts.shape[1] - 1)
+        ].add(bump)
+        return (req_state, nzr_state, counts), assignment
+
+    (req_out, nzr_out, counts_out), assignments = jax.lax.scan(
+        step,
+        (requested, nzr, group_counts),
+        (
+            pod_requests, pod_nzr, static_mask, active,
+            pod_groups, pod_max_skew, pod_self, pod_match,
+        ),
+    )
+    return assignments, req_out, nzr_out, counts_out
+
+
 def make_sharded_solver(mesh: "jax.sharding.Mesh", config: GreedyConfig = GreedyConfig()):
     """Build a node-axis-sharded greedy solver for a device mesh.
 

@@ -1,0 +1,76 @@
+"""Sinkhorn optimal-transport assignment prior.
+
+The greedy scan (assignment.py) is the parity-mode solver: it replays the
+reference's sequential argmax exactly. For the churn/rebalance regime
+(BASELINE.json config #5: 50k-node x 100k-pod churn + descheduler
+rebalance) a myopic per-pod argmax packs poorly: early pods grab globally
+contested nodes. Sinkhorn computes a soft transport plan between the pod
+batch (unit demand each) and node slot capacities, giving every pod a
+globally-aware placement prior; the final commitment still runs through
+the capacity-replay scan (greedy_assign with the plan as the score
+matrix), so feasibility is never soft.
+
+Under a node-sharded mesh the column normalization is a per-shard
+reduce + the row normalization an all-reduce over ICI -- exactly the
+psum-based pattern SURVEY.md section 2.5 calls for; with jit +
+NamedSharding XLA inserts those collectives automatically.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG = -1e9
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def sinkhorn_plan(
+    score: jnp.ndarray,  # [B, N] float32 (higher = better)
+    feasible: jnp.ndarray,  # [B, N] bool
+    node_slots: jnp.ndarray,  # [N] float32 estimated free pod slots
+    active: jnp.ndarray,  # [B] bool
+    iters: int = 50,
+    tau: float = 20.0,
+) -> jnp.ndarray:
+    """Entropic-OT transport plan in log space.
+
+    Rows (pods) have unit mass; columns (nodes) are capped at
+    ``node_slots``. Returns the plan [B, N] (mass in [0,1]); infeasible
+    cells carry ~0 mass."""
+    log_k = jnp.where(feasible, score / tau, NEG)
+    log_k = jnp.where(active[:, None], log_k, NEG)
+    log_slots = jnp.log(jnp.maximum(node_slots, 1e-6))
+    f = jnp.zeros(score.shape[0], dtype=jnp.float32)  # row potentials
+    g = jnp.zeros(score.shape[1], dtype=jnp.float32)  # col potentials
+
+    def body(_, fg):
+        f, g = fg
+        # rows: unit mass each (all-reduce over the node axis)
+        f = -jax.nn.logsumexp(log_k + g[None, :], axis=1)
+        f = jnp.where(active, f, 0.0)
+        # cols: capacity-capped (never force mass INTO a column --
+        # unbalanced OT: g <= capped value)
+        col = jax.nn.logsumexp(log_k + f[:, None], axis=0)
+        g = jnp.minimum(0.0, log_slots - col)
+        return f, g
+
+    f, g = jax.lax.fori_loop(0, iters, body, (f, g))
+    return jnp.exp(log_k + f[:, None] + g[None, :])
+
+
+def refine_scores(
+    score: jnp.ndarray,
+    feasible: jnp.ndarray,
+    node_slots: jnp.ndarray,
+    active: jnp.ndarray,
+    iters: int = 50,
+    tau: float = 20.0,
+) -> jnp.ndarray:
+    """Blend the transport plan into a score matrix for the commit scan:
+    plan mass dominates, raw score breaks ties among equal-mass nodes."""
+    plan = sinkhorn_plan(score, feasible, node_slots, active, iters, tau)
+    return plan * 1e4 + jnp.where(feasible, score, 0.0)

@@ -12,6 +12,7 @@ from kubernetes_tpu.framework.registry import Registry
 def new_in_tree_registry() -> Registry:
     """Reference framework/plugins/registry.go:45 NewInTreeRegistry."""
     from kubernetes_tpu.plugins import (
+        coscheduling,
         defaultbinder,
         imagelocality,
         interpodaffinity,
@@ -98,5 +99,9 @@ def new_in_tree_registry() -> Registry:
     )
     r.register(
         selectorspread.NodeLabel.NAME, lambda a, h: selectorspread.NodeLabel(a)
+    )
+    r.register(
+        coscheduling.Coscheduling.NAME,
+        lambda a, h: coscheduling.Coscheduling(a, h),
     )
     return r

@@ -29,8 +29,14 @@ import jax.numpy as jnp
 
 from kubernetes_tpu.api.types import Pod
 from kubernetes_tpu.framework.interface import CycleState, FitError, PodInfo
-from kubernetes_tpu.ops.assignment import GreedyConfig, NO_NODE, greedy_assign
+from kubernetes_tpu.ops.assignment import (
+    GreedyConfig,
+    NO_NODE,
+    greedy_assign,
+    greedy_assign_spread,
+)
 from kubernetes_tpu.ops.host_masks import static_mask
+from kubernetes_tpu.ops.topology import pack_spread_batch
 from kubernetes_tpu.scheduler.generic import SNAPSHOT_STATE_KEY
 from kubernetes_tpu.scheduler.scheduler import Scheduler
 from kubernetes_tpu.tensors import NodeTensorCache, pack_pod_batch
@@ -45,7 +51,20 @@ def solver_supported(pod: Pod) -> bool:
     """Constraints the device solver models today. Anything else falls
     back to the sequential path (still fully correct, just not batched)."""
     spec = pod.spec
-    if spec.topology_spread_constraints:
+    for c in spec.topology_spread_constraints:
+        # hard constraints are solved on device via the group-count scan
+        # (ops/topology.py); soft ones shape scoring, which the device
+        # scorer set doesn't include yet; combining spread with node
+        # selectors changes pair-count eligibility per pod
+        if c.when_unsatisfiable != "DoNotSchedule":
+            return False
+    if spec.topology_spread_constraints and (
+        spec.node_selector
+        or (
+            spec.affinity is not None
+            and spec.affinity.node_affinity is not None
+        )
+    ):
         return False
     a = spec.affinity
     if a is not None and (
@@ -213,8 +232,21 @@ class BatchScheduler(Scheduler):
         sm[:b] = smask[order]
         active[:b] = True
 
+        # hard topology-spread constraints solve on device via the
+        # group-count scan (ops/topology.py)
+        spread = None
+        if any(p.spec.topology_spread_constraints for p in pods):
+            ordered_pods = [pods[int(i)] for i in order]
+            spread = pack_spread_batch(ordered_pods, snapshot, nt)
+            if spread is None:
+                # envelope exceeded: host path keeps full correctness
+                for pi in solver_infos:
+                    self.pods_fallback += 1
+                    self.attempt_schedule(pi)
+                return
+
         solve_timer = metrics.SinceTimer(metrics.batch_solve_duration)
-        assignments, _, _ = greedy_assign(
+        common_args = (
             jnp.asarray(nt.allocatable),
             jnp.asarray(node_requested),
             jnp.asarray(node_nzr),
@@ -223,8 +255,32 @@ class BatchScheduler(Scheduler):
             jnp.asarray(nzr),
             jnp.asarray(sm),
             jnp.asarray(active),
-            config=self.solver_config,
         )
+        if spread is None:
+            assignments, _, _ = greedy_assign(
+                *common_args, config=self.solver_config
+            )
+        else:
+            c = spread.pod_groups.shape[1]
+            pg = np.full((padded, c), -1, dtype=np.int32)
+            ps = np.zeros((padded, c), dtype=np.int32)
+            pm = np.zeros((padded, spread.pod_match.shape[1]), dtype=np.int32)
+            pg[:b] = spread.pod_groups
+            ps[:b] = spread.pod_self
+            pm[:b] = spread.pod_match
+            sk = np.zeros((padded, c), dtype=np.int32)
+            sk[:b] = spread.pod_max_skew
+            assignments, _, _, _ = greedy_assign_spread(
+                *common_args,
+                jnp.asarray(spread.group_counts),
+                jnp.asarray(spread.value_valid),
+                jnp.asarray(spread.node_value),
+                jnp.asarray(pg),
+                jnp.asarray(sk),
+                jnp.asarray(ps),
+                jnp.asarray(pm),
+                config=self.solver_config,
+            )
         assignments = np.asarray(assignments)
         solve_timer.observe()
         metrics.batch_size.observe(b)

@@ -73,6 +73,14 @@ def add_all_event_handlers(
     # unscheduled pods owned by one of our profiles -> queue (:381)
     def add_pod_to_queue(pod: Pod) -> None:
         sched.queue.add(pod)
+        # a new gang member can unblock siblings rejected by the
+        # coscheduling fail-fast (total < minMember) -- wake them
+        from kubernetes_tpu.api.types import POD_GROUP_LABEL
+
+        if pod.metadata.labels.get(POD_GROUP_LABEL):
+            sched.queue.move_all_to_active_or_backoff_queue(
+                "PodGroupMemberAdd"
+            )
 
     def update_pod_in_queue(old: Pod, new: Pod) -> None:
         sched.queue.update(old, new)

@@ -326,7 +326,7 @@ class Preemptor:
                         pass
                 waiting = prof.get_waiting_pod(victim.metadata.uid)
                 if waiting is not None:
-                    waiting.reject("preempted")
+                    waiting.reject("preemption", "preempted")
         for p in to_clear:
             self.queue.delete_nominated_pod_if_exists(p)
             if self.client is not None and p.status.nominated_node_name:

@@ -20,6 +20,7 @@ def default_plugins() -> Plugins:
                 P("NodePorts"),
                 P("PodTopologySpread"),
                 P("InterPodAffinity"),
+                P("Coscheduling"),
             ]
         ),
         filter=PluginSet(
@@ -66,6 +67,9 @@ def default_plugins() -> Plugins:
         # (scheduler.go:693 bindVolumes); this build routes it through the
         # PreBind extension point of the same plugin (volumes.py docstring)
         pre_bind=PluginSet(enabled=[P("VolumeBinding")]),
+        # gang scheduling: the out-of-tree coscheduling pattern, enabled by
+        # default in this build (no-op for pods without a pod-group label)
+        permit=PluginSet(enabled=[P("Coscheduling")]),
         bind=PluginSet(enabled=[P("DefaultBinder")]),
     )
 

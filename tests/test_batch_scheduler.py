@@ -100,12 +100,15 @@ class TestBatchScheduling:
         informers.start()
         informers.wait_for_cache_sync()
         sched.queue.run()
-        # spread-constrained pods are not solver_supported -> fallback
+        # SOFT spread constraints shape scoring -> not solver_supported
         for i in range(4):
             client.create_pod(
                 make_pod(f"s{i}").labels(app="s")
                 .container(cpu="100m")
-                .spread_constraint(1, "zone", match_labels={"app": "s"})
+                .spread_constraint(
+                    1, "zone", when_unsatisfiable="ScheduleAnyway",
+                    match_labels={"app": "s"},
+                )
                 .obj()
             )
         for i in range(4):
@@ -299,9 +302,22 @@ class TestSolverSupported:
             make_pod("p").pod_affinity("zone", {"a": "b"}).obj()
         )
 
-    def test_spread_not_supported(self):
-        assert not solver_supported(
+    def test_hard_spread_supported_on_device(self):
+        assert solver_supported(
             make_pod("p").spread_constraint(1, "zone").obj()
+        )
+
+    def test_soft_spread_not_supported(self):
+        assert not solver_supported(
+            make_pod("p").spread_constraint(
+                1, "zone", when_unsatisfiable="ScheduleAnyway"
+            ).obj()
+        )
+
+    def test_spread_plus_node_selector_not_supported(self):
+        assert not solver_supported(
+            make_pod("p").spread_constraint(1, "zone")
+            .node_selector(pool="x").obj()
         )
 
     def test_node_selector_supported(self):

@@ -1,0 +1,121 @@
+"""Gang scheduling tests: Permit barrier, all-or-nothing, timeout
+rollback (reference mechanism: Permit/WaitingPod, SURVEY.md section 2.2)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import ObjectMeta, POD_GROUP_LABEL, PodGroup
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _gang_pod(name, group, cpu="500m", ts=0.0):
+    p = (
+        make_pod(name).creation_timestamp(ts)
+        .container(cpu=cpu, memory="256Mi").obj()
+    )
+    p.metadata.labels[POD_GROUP_LABEL] = group
+    return p
+
+
+@pytest.fixture(params=[False, True], ids=["sequential", "batch"])
+def cluster(request):
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(client, informers, batch=request.param)
+    yield server, client, informers, sched
+    sched.stop()
+    informers.stop()
+
+
+def _wait(fn, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestGang:
+    def test_full_gang_binds_together(self, cluster):
+        server, client, informers, sched = cluster
+        client.create_node(make_node("n").capacity(cpu="8", memory="16Gi").obj())
+        client.create_pod_group(PodGroup(
+            metadata=ObjectMeta(name="job", namespace="default"),
+            min_member=3, schedule_timeout_seconds=30,
+        ))
+        informers.start()
+        informers.wait_for_cache_sync()
+        for i in range(3):
+            client.create_pod(_gang_pod(f"g{i}", "job", ts=float(i)))
+        sched.start()
+        ok = _wait(lambda: all(
+            p.spec.node_name for p in client.list_pods()[0]
+        ))
+        sched.wait_for_inflight_binds()
+        assert ok, "gang never fully bound"
+
+    def test_partial_gang_times_out_and_releases(self, cluster):
+        server, client, informers, sched = cluster
+        client.create_node(make_node("n").capacity(cpu="8", memory="16Gi").obj())
+        client.create_pod_group(PodGroup(
+            metadata=ObjectMeta(name="job", namespace="default"),
+            min_member=3, schedule_timeout_seconds=1,
+        ))
+        informers.start()
+        informers.wait_for_cache_sync()
+        # only 2 of 3 members exist: PreFilter fails fast, nothing binds
+        for i in range(2):
+            client.create_pod(_gang_pod(f"g{i}", "job", ts=float(i)))
+        sched.start()
+        time.sleep(2.5)
+        sched.wait_for_inflight_binds()
+        pods, _ = client.list_pods()
+        assert all(not p.spec.node_name for p in pods), [
+            (p.name, p.spec.node_name) for p in pods
+        ]
+        # capacity must have been released: a plain pod schedules fine
+        client.create_pod(make_pod("plain").container(cpu="7").obj())
+        ok = _wait(
+            lambda: client.get_pod("default", "plain").spec.node_name != ""
+        )
+        assert ok, "capacity not released after gang failure"
+
+    def test_gang_members_arriving_late_complete(self, cluster):
+        server, client, informers, sched = cluster
+        client.create_node(make_node("n").capacity(cpu="8", memory="16Gi").obj())
+        client.create_pod_group(PodGroup(
+            metadata=ObjectMeta(name="job", namespace="default"),
+            min_member=2, schedule_timeout_seconds=30,
+        ))
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.start()
+        client.create_pod(_gang_pod("early", "job", ts=0.0))
+        time.sleep(0.5)
+        # first member alone must not be bound yet (waiting at permit)
+        assert not client.get_pod("default", "early").spec.node_name
+        client.create_pod(_gang_pod("late", "job", ts=1.0))
+        ok = _wait(lambda: all(
+            p.spec.node_name for p in client.list_pods()[0]
+        ))
+        sched.wait_for_inflight_binds()
+        assert ok, "gang did not complete when the second member arrived"
+
+    def test_non_gang_pods_unaffected(self, cluster):
+        server, client, informers, sched = cluster
+        client.create_node(make_node("n").capacity(cpu="4", memory="8Gi").obj())
+        informers.start()
+        informers.wait_for_cache_sync()
+        client.create_pod(make_pod("p").container(cpu="1").obj())
+        sched.start()
+        ok = _wait(
+            lambda: client.get_pod("default", "p").spec.node_name != ""
+        )
+        assert ok

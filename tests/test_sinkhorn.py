@@ -1,0 +1,80 @@
+"""Sinkhorn OT assignment tests."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops.assignment import (
+    NO_NODE,
+    greedy_assign_scored,
+)
+from kubernetes_tpu.ops.sinkhorn import refine_scores, sinkhorn_plan
+
+
+def test_plan_respects_capacities():
+    b, n = 6, 3
+    score = jnp.zeros((b, n), dtype=jnp.float32)
+    feasible = jnp.ones((b, n), dtype=bool)
+    slots = jnp.asarray([1.0, 2.0, 3.0])
+    active = jnp.ones(b, dtype=bool)
+    plan = np.asarray(sinkhorn_plan(score, feasible, slots, active))
+    col = plan.sum(axis=0)
+    assert (col <= np.asarray(slots) + 0.05).all(), col
+    # every pod keeps ~unit mass
+    assert np.allclose(plan.sum(axis=1), 1.0, atol=0.05)
+
+
+def test_infeasible_cells_carry_no_mass():
+    score = jnp.zeros((2, 2), dtype=jnp.float32)
+    feasible = jnp.asarray([[True, False], [True, True]])
+    plan = np.asarray(sinkhorn_plan(
+        score, feasible, jnp.asarray([5.0, 5.0]), jnp.ones(2, dtype=bool)
+    ))
+    assert plan[0, 1] < 1e-6
+
+
+def test_global_plan_beats_myopic_contention():
+    """2 pods, 2 nodes. Node 0 scores higher for both, but has one slot;
+    the OT plan routes one pod to node 1 so both place with high mass."""
+    score = jnp.asarray([[10.0, 9.0], [10.0, 1.0]], dtype=jnp.float32)
+    feasible = jnp.ones((2, 2), dtype=bool)
+    slots = jnp.asarray([1.0, 1.0])
+    plan = np.asarray(sinkhorn_plan(
+        score, feasible, slots, jnp.ones(2, dtype=bool), tau=2.0
+    ))
+    # pod 1 (who NEEDS node 0 much more) gets node 0; pod 0 shifts to 1
+    assert plan[1, 0] > plan[0, 0]
+    assert plan[0, 1] > plan[1, 1]
+
+
+def test_scored_scan_commits_feasible_assignment():
+    n, b, r = 4, 6, 2
+    alloc = np.zeros((n, r), dtype=np.int32)
+    alloc[:, 0] = 2000  # cpu
+    alloc[:, 1] = 10  # pods
+    requested = np.zeros_like(alloc)
+    pod_req = np.zeros((b, r), dtype=np.int32)
+    pod_req[:, 0] = 1000
+    pod_req[:, 1] = 1
+    static = np.ones((b, n), dtype=bool)
+    active = np.ones(b, dtype=bool)
+    score = refine_scores(
+        jnp.zeros((b, n), dtype=jnp.float32),
+        jnp.asarray(static),
+        jnp.full((n,), 2.0, dtype=jnp.float32),
+        jnp.asarray(active),
+    )
+    assignments, req_out = greedy_assign_scored(
+        jnp.asarray(alloc),
+        jnp.asarray(requested),
+        jnp.ones(n, dtype=bool),
+        jnp.asarray(pod_req),
+        jnp.asarray(static),
+        jnp.asarray(active),
+        score,
+    )
+    a = np.asarray(assignments)
+    # 4 nodes x 2 cpu slots = 8 >= 6 pods: all placed, never over capacity
+    assert (a != NO_NODE).all()
+    assert (np.asarray(req_out)[:, 0] <= 2000).all()
+    counts = np.bincount(a, minlength=n)
+    assert counts.max() <= 2

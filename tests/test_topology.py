@@ -1,0 +1,180 @@
+"""Device-side topology spread: pack + scan parity with the sequential
+PodTopologySpread plugin, including within-batch count replay."""
+
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.cache.snapshot import new_snapshot
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.ops.assignment import greedy_assign_spread
+from kubernetes_tpu.ops.topology import pack_spread_batch
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.tensors import NodeTensorCache, pack_pod_batch
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _zone_cluster():
+    nodes = [
+        make_node("n1a").labels(zone="z1").capacity(cpu="16", memory="32Gi").obj(),
+        make_node("n1b").labels(zone="z1").capacity(cpu="16", memory="32Gi").obj(),
+        make_node("n2a").labels(zone="z2").capacity(cpu="16", memory="32Gi").obj(),
+        make_node("n2b").labels(zone="z2").capacity(cpu="16", memory="32Gi").obj(),
+    ]
+    return nodes
+
+
+def _spread_pod(name, ts):
+    return (
+        make_pod(name).labels(app="web").creation_timestamp(ts)
+        .container(cpu="500m", memory="512Mi")
+        .spread_constraint(1, "zone", match_labels={"app": "web"})
+        .obj()
+    )
+
+
+class TestPackSpreadBatch:
+    def test_initial_counts_and_groups(self):
+        nodes = _zone_cluster()
+        existing = [
+            make_pod("e1").node("n1a").labels(app="web").obj(),
+            make_pod("e2").node("n1b").labels(app="web").obj(),
+            make_pod("e3").node("n2a").labels(app="other").obj(),
+        ]
+        snap = new_snapshot(existing, nodes)
+        nt = NodeTensorCache().update(snap)
+        pods = [_spread_pod("p0", 0.0), _spread_pod("p1", 1.0)]
+        sp = pack_spread_batch(pods, snap, nt)
+        assert sp is not None
+        # one group: (default, zone, app=web); z1 has 2 matches, z2 has 0
+        counts = sorted(
+            sp.group_counts[0][sp.value_valid[0]].tolist()
+        )
+        assert counts == [0, 2]
+        assert sp.pod_groups[0, 0] == sp.pod_groups[1, 0] == 0
+        assert sp.pod_self[:, 0].all()
+        assert sp.pod_match[:, 0].all()
+
+    def test_node_selector_combo_falls_back(self):
+        nodes = _zone_cluster()
+        snap = new_snapshot([], nodes)
+        nt = NodeTensorCache().update(snap)
+        pod = (
+            make_pod("p").labels(app="web")
+            .spread_constraint(1, "zone", match_labels={"app": "web"})
+            .node_selector(pool="x")
+            .obj()
+        )
+        assert pack_spread_batch([pod], snap, nt) is None
+
+
+class TestSpreadScan:
+    def test_within_batch_spread_maxskew_1(self):
+        """8 pods in ONE batch must land 4/4 across zones -- only possible
+        if the scan replays counts between steps."""
+        nodes = _zone_cluster()
+        snap = new_snapshot([], nodes)
+        nt = NodeTensorCache().update(snap)
+        pods = [_spread_pod(f"p{i}", float(i)) for i in range(8)]
+        batch = pack_pod_batch(pods, nt.dims)
+        order = batch.order
+        sp = pack_spread_batch([pods[int(i)] for i in order], snap, nt)
+        b = batch.size
+        static = np.ones((b, nt.capacity), dtype=bool)
+        assignments, _, _, counts = greedy_assign_spread(
+            jnp.asarray(nt.allocatable),
+            jnp.asarray(nt.requested),
+            jnp.asarray(nt.non_zero_requested),
+            jnp.asarray(nt.valid),
+            jnp.asarray(batch.requests[order]),
+            jnp.asarray(batch.non_zero_requests[order]),
+            jnp.asarray(static),
+            jnp.asarray(np.ones(b, dtype=bool)),
+            jnp.asarray(sp.group_counts),
+            jnp.asarray(sp.value_valid),
+            jnp.asarray(sp.node_value),
+            jnp.asarray(sp.pod_groups),
+            jnp.asarray(sp.pod_max_skew),
+            jnp.asarray(sp.pod_self),
+            jnp.asarray(sp.pod_match),
+        )
+        assignments = np.asarray(assignments)
+        assert (assignments >= 0).all()
+        zone_of = {0: "z1", 1: "z1", 2: "z2", 3: "z2"}
+        by_zone = {"z1": 0, "z2": 0}
+        for a in assignments:
+            by_zone[zone_of[int(a)]] += 1
+        assert by_zone == {"z1": 4, "z2": 4}
+        final_counts = np.asarray(counts)[0]
+        assert sorted(final_counts[np.asarray(sp.value_valid)[0]].tolist()) \
+            == [4, 4]
+
+    def test_skew_blocks_overloaded_zone(self):
+        """Existing imbalance: z1 has 3 matching pods, z2 has 0; a new
+        maxSkew=1 pod must land in z2."""
+        nodes = _zone_cluster()
+        existing = [
+            make_pod(f"e{i}").node("n1a").labels(app="web").obj()
+            for i in range(3)
+        ]
+        snap = new_snapshot(existing, nodes)
+        nt = NodeTensorCache().update(snap)
+        pods = [_spread_pod("p", 0.0)]
+        batch = pack_pod_batch(pods, nt.dims)
+        sp = pack_spread_batch(pods, snap, nt)
+        assignments, _, _, _ = greedy_assign_spread(
+            jnp.asarray(nt.allocatable),
+            jnp.asarray(nt.requested),
+            jnp.asarray(nt.non_zero_requested),
+            jnp.asarray(nt.valid),
+            jnp.asarray(batch.requests),
+            jnp.asarray(batch.non_zero_requests),
+            jnp.asarray(np.ones((1, nt.capacity), dtype=bool)),
+            jnp.asarray(np.ones(1, dtype=bool)),
+            jnp.asarray(sp.group_counts),
+            jnp.asarray(sp.value_valid),
+            jnp.asarray(sp.node_value),
+            jnp.asarray(sp.pod_groups),
+            jnp.asarray(sp.pod_max_skew),
+            jnp.asarray(sp.pod_self),
+            jnp.asarray(sp.pod_match),
+        )
+        choice = int(np.asarray(assignments)[0])
+        assert nt.names[choice] in ("n2a", "n2b")
+
+
+class TestEndToEndDeviceSpread:
+    def test_batch_scheduler_spreads_on_device(self):
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(client, informers, batch=True, max_batch=64)
+        for n in _zone_cluster():
+            client.create_node(n)
+        informers.start()
+        informers.wait_for_cache_sync()
+        for i in range(12):
+            client.create_pod(_spread_pod(f"w{i}", float(i)))
+        sched.start()
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            pods, _ = client.list_pods()
+            if all(p.spec.node_name for p in pods):
+                break
+            time.sleep(0.05)
+        sched.wait_for_inflight_binds()
+        pods, _ = client.list_pods()
+        zone_of = {"n1a": "z1", "n1b": "z1", "n2a": "z2", "n2b": "z2"}
+        by_zone = {"z1": 0, "z2": 0}
+        for p in pods:
+            assert p.spec.node_name, p.name
+            by_zone[zone_of[p.spec.node_name]] += 1
+        assert by_zone == {"z1": 6, "z2": 6}
+        assert sched.pods_fallback == 0  # all solved on device
+        assert sched.pods_solved_on_device >= 12
+        sched.stop()
+        informers.stop()
