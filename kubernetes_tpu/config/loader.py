@@ -8,6 +8,7 @@ the reference's camelCase wire form.
 
 from __future__ import annotations
 
+import re
 from typing import Any, Dict, List, Optional
 
 import yaml
@@ -68,6 +69,32 @@ def _profile(raw: Dict[str, Any]) -> KubeSchedulerProfile:
     )
 
 
+_DURATION_UNITS = {
+    "ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3,
+    "s": 1.0, "m": 60.0, "h": 3600.0,
+}
+
+
+def _duration_seconds(raw: Any) -> float:
+    """Accept numeric seconds or Go-style duration strings ("30s",
+    "1m30s", "500ms") -- the reference wire format expresses HTTPTimeout
+    and the leader-election knobs as metav1.Duration."""
+    if isinstance(raw, (int, float)):
+        return float(raw)
+    s = str(raw).strip()
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    total = 0.0
+    m = re.fullmatch(r"(?:\d+(?:\.\d+)?(?:ns|us|µs|ms|s|m|h))+", s)
+    if not m:
+        raise ValueError(f"invalid duration {raw!r}")
+    for num, unit in re.findall(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)", s):
+        total += float(num) * _DURATION_UNITS[unit]
+    return total
+
+
 def _extender(raw: Dict[str, Any]) -> ExtenderConfig:
     return ExtenderConfig(
         url_prefix=raw.get("urlPrefix", ""),
@@ -81,7 +108,7 @@ def _extender(raw: Dict[str, Any]) -> ExtenderConfig:
         managed_resources=[
             r["name"] for r in raw.get("managedResources", [])
         ],
-        http_timeout_seconds=float(raw.get("httpTimeout", 5.0)),
+        http_timeout_seconds=_duration_seconds(raw.get("httpTimeout", 5.0)),
     )
 
 
@@ -98,9 +125,9 @@ def load_config_from_dict(raw: Dict[str, Any]) -> KubeSchedulerConfiguration:
         pod_max_backoff_seconds=float(raw.get("podMaxBackoffSeconds", 10.0)),
         leader_election=LeaderElectionConfiguration(
             leader_elect=bool(le_raw.get("leaderElect", False)),
-            lease_duration_seconds=float(le_raw.get("leaseDuration", 15.0)),
-            renew_deadline_seconds=float(le_raw.get("renewDeadline", 10.0)),
-            retry_period_seconds=float(le_raw.get("retryPeriod", 2.0)),
+            lease_duration_seconds=_duration_seconds(le_raw.get("leaseDuration", 15.0)),
+            renew_deadline_seconds=_duration_seconds(le_raw.get("renewDeadline", 10.0)),
+            retry_period_seconds=_duration_seconds(le_raw.get("retryPeriod", 2.0)),
             resource_name=le_raw.get("resourceName", "kube-scheduler"),
             resource_namespace=le_raw.get("resourceNamespace", "kube-system"),
         ),

@@ -41,6 +41,30 @@ from kubernetes_tpu.ops.scores import (
 
 NO_NODE = -1
 
+_PODS_COL = 3  # tensors/node_tensor.py PODS: the pod-count dimension
+
+
+def _fits(free: jnp.ndarray, pod_req: jnp.ndarray) -> jnp.ndarray:
+    """Fit semantics (fit.go:181-252): the pod-count dimension is always
+    checked; when every OTHER request is zero the reference short-circuits
+    after it; otherwise EVERY dimension is checked strictly -- a zero
+    request on an over-committed dimension (requested > allocatable,
+    reachable via the nominated-pod overlay) still rejects, because the
+    reference test is ``allocatable < requested + request``.
+
+    free: [N, R] (allocatable - requested), pod_req: [R]. Returns [N] bool.
+    """
+    cols = jnp.arange(pod_req.shape[0])
+    dim_ok = pod_req[None, :] <= free  # [N, R]
+    # scalar/extended columns (>= NUM_FIXED_DIMS) are only checked when the
+    # pod actually requests them: fit.go iterates podRequest.ScalarResources,
+    # unlike the fixed cpu/memory/ephemeral checks which are unconditional
+    scalar_skip = (cols >= 4) & (pod_req == 0)
+    dim_ok = dim_ok | scalar_skip[None, :]
+    nonpods = cols != _PODS_COL
+    all_zero = jnp.max(jnp.where(nonpods, pod_req, 0)) == 0
+    return jnp.where(all_zero, dim_ok[:, _PODS_COL], dim_ok.all(axis=-1))
+
 
 @dataclass(frozen=True)
 class GreedyConfig:
@@ -81,9 +105,7 @@ def greedy_assign(
         pod_req, p_nzr, smask, is_active = inputs
 
         free = allocatable - req_state
-        fits = ((pod_req[None, :] <= free) | (pod_req[None, :] == 0)).all(
-            axis=-1
-        )
+        fits = _fits(free, pod_req)
         feasible = fits & smask & valid
 
         score = jnp.zeros((n,), dtype=jnp.float32)
@@ -139,9 +161,7 @@ def greedy_assign_scored(
         req_state = carry
         pod_req, smask, is_active, row = inputs
         free = allocatable - req_state
-        fits = ((pod_req[None, :] <= free) | (pod_req[None, :] == 0)).all(
-            axis=-1
-        )
+        fits = _fits(free, pod_req)
         feasible = fits & smask & valid
         score = jnp.where(feasible, row, -jnp.inf)
         choice = jnp.argmax(score).astype(jnp.int32)
@@ -191,9 +211,7 @@ def greedy_assign_spread(
         pod_req, p_nzr, smask, is_active, groups, skews, selfs, match = inputs
 
         free = allocatable - req_state
-        fits = ((pod_req[None, :] <= free) | (pod_req[None, :] == 0)).all(
-            axis=-1
-        )
+        fits = _fits(free, pod_req)
         feasible = fits & smask & valid
 
         # spread check per constraint slot (filtering.go:322 skew rule)

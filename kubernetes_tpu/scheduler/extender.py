@@ -44,7 +44,156 @@ class ExtenderConfig:
     http_timeout_seconds: float = DEFAULT_EXTENDER_TIMEOUT_SECONDS
 
 
+def _quantity_to_wire(name: str, qty: int) -> str:
+    # internal base units: cpu milliCPU, memory/ephemeral bytes, extended
+    # whole units (api/types.py ResourceList)
+    if name == "cpu":
+        return f"{qty}m"
+    return str(qty)
+
+
+def _resource_list_to_wire(rl: dict) -> dict:
+    return {name: _quantity_to_wire(name, q) for name, q in rl.items()}
+
+
+def _label_selector_to_wire(sel) -> dict:
+    out: dict = {}
+    if sel.match_labels:
+        out["matchLabels"] = dict(sel.match_labels)
+    if sel.match_expressions:
+        out["matchExpressions"] = [
+            {"key": r.key, "operator": r.operator, "values": list(r.values)}
+            for r in sel.match_expressions
+        ]
+    return out
+
+
+def _node_selector_term_to_wire(term) -> dict:
+    out: dict = {}
+    if term.match_expressions:
+        out["matchExpressions"] = [
+            {"key": r.key, "operator": r.operator, "values": list(r.values)}
+            for r in term.match_expressions
+        ]
+    if term.match_fields:
+        out["matchFields"] = [
+            {"key": r.key, "operator": r.operator, "values": list(r.values)}
+            for r in term.match_fields
+        ]
+    return out
+
+
+def _pod_affinity_term_to_wire(term) -> dict:
+    out: dict = {"topologyKey": term.topology_key}
+    if term.label_selector is not None:
+        out["labelSelector"] = _label_selector_to_wire(term.label_selector)
+    if term.namespaces:
+        out["namespaces"] = list(term.namespaces)
+    return out
+
+
+def _affinity_to_wire(a) -> dict:
+    out: dict = {}
+    if a.node_affinity is not None:
+        na: dict = {}
+        if a.node_affinity.required_during_scheduling is not None:
+            na["requiredDuringSchedulingIgnoredDuringExecution"] = {
+                "nodeSelectorTerms": [
+                    _node_selector_term_to_wire(t)
+                    for t in a.node_affinity.required_during_scheduling.node_selector_terms
+                ]
+            }
+        if a.node_affinity.preferred_during_scheduling:
+            na["preferredDuringSchedulingIgnoredDuringExecution"] = [
+                {
+                    "weight": p.weight,
+                    "preference": _node_selector_term_to_wire(p.preference),
+                }
+                for p in a.node_affinity.preferred_during_scheduling
+            ]
+        out["nodeAffinity"] = na
+    for attr, key in (
+        ("pod_affinity", "podAffinity"),
+        ("pod_anti_affinity", "podAntiAffinity"),
+    ):
+        pa = getattr(a, attr)
+        if pa is not None:
+            out[key] = {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    _pod_affinity_term_to_wire(t)
+                    for t in pa.required_during_scheduling
+                ],
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "weight": w.weight,
+                        "podAffinityTerm": _pod_affinity_term_to_wire(
+                            w.pod_affinity_term
+                        ),
+                    }
+                    for w in pa.preferred_during_scheduling
+                ],
+            }
+    return out
+
+
 def _pod_to_wire(pod: Pod) -> dict:
+    """Full Pod serialization for ExtenderArgs. The reference sends the
+    whole v1.Pod (extender/v1/types.go ExtenderArgs), so real extenders
+    inspect spec fields -- containers/resources, nodeSelector, affinity,
+    tolerations -- not just metadata."""
+    def container_to_wire(c) -> dict:
+        return {
+            "name": c.name,
+            "image": c.image,
+            "resources": {
+                "requests": _resource_list_to_wire(c.resources.requests),
+                "limits": _resource_list_to_wire(c.resources.limits),
+            },
+            "ports": [
+                {
+                    "containerPort": p.container_port,
+                    "hostPort": p.host_port,
+                    "hostIP": p.host_ip,
+                    "protocol": p.protocol,
+                }
+                for p in c.ports
+            ],
+        }
+
+    spec: dict = {
+        "priority": pod.spec.priority,
+        "schedulerName": pod.spec.scheduler_name,
+        "containers": [container_to_wire(c) for c in pod.spec.containers],
+    }
+    if pod.spec.init_containers:
+        spec["initContainers"] = [
+            container_to_wire(c) for c in pod.spec.init_containers
+        ]
+    if pod.spec.overhead:
+        spec["overhead"] = _resource_list_to_wire(pod.spec.overhead)
+    if pod.spec.node_name:
+        spec["nodeName"] = pod.spec.node_name
+    if pod.spec.priority_class_name:
+        spec["priorityClassName"] = pod.spec.priority_class_name
+    if pod.spec.node_selector:
+        spec["nodeSelector"] = dict(pod.spec.node_selector)
+    if pod.spec.tolerations:
+        spec["tolerations"] = [
+            {
+                "key": t.key,
+                "operator": t.operator,
+                "value": t.value,
+                "effect": t.effect,
+                **(
+                    {"tolerationSeconds": t.toleration_seconds}
+                    if t.toleration_seconds is not None
+                    else {}
+                ),
+            }
+            for t in pod.spec.tolerations
+        ]
+    if pod.spec.affinity is not None:
+        spec["affinity"] = _affinity_to_wire(pod.spec.affinity)
     return {
         "metadata": {
             "name": pod.metadata.name,
@@ -52,7 +201,10 @@ def _pod_to_wire(pod: Pod) -> dict:
             "uid": pod.metadata.uid,
             "labels": dict(pod.metadata.labels),
         },
-        "spec": {"priority": pod.spec.priority},
+        "spec": spec,
+        "status": {
+            "nominatedNodeName": pod.status.nominated_node_name,
+        },
     }
 
 

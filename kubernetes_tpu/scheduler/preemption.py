@@ -127,8 +127,10 @@ def pick_one_node_for_preemption(
         return candidates[0]
     # 5. latest earliest-start-time among highest-priority victims
     def earliest_start(n: str) -> float:
+        # victims are ordered PDB-violating-first, so pods[0] need not be
+        # the highest priority; scan all (GetEarliestPodStartTime).
         pods = nodes_to_victims[n].pods
-        max_prio = pods[0].spec.priority
+        max_prio = max(p.spec.priority for p in pods)
         return min(
             pod_start_time(p) for p in pods if p.spec.priority == max_prio
         )

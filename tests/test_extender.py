@@ -170,3 +170,38 @@ class TestEndToEndWithExtender:
         informers.stop()
         assert bound, "extender bind verb never called"
         assert _ExtenderHandler.bindings[0]["node"] == "preferred"
+
+
+class TestWireFormat:
+    def test_pod_wire_carries_full_spec(self):
+        from kubernetes_tpu.scheduler.extender import _pod_to_wire
+
+        pod = (
+            make_pod("wire", "prod")
+            .labels(app="db")
+            .container(cpu="250m", memory="512Mi")
+            .obj()
+        )
+        pod.spec.node_selector = {"disktype": "ssd"}
+        wire = _pod_to_wire(pod)
+        assert wire["metadata"]["name"] == "wire"
+        spec = wire["spec"]
+        assert spec["nodeSelector"] == {"disktype": "ssd"}
+        c = spec["containers"][0]
+        assert c["resources"]["requests"]["cpu"] == "250m"
+        assert c["resources"]["requests"]["memory"] == str(512 * 1024 * 1024)
+
+    def test_pod_wire_serializes_affinity(self):
+        from kubernetes_tpu.scheduler.extender import _pod_to_wire
+
+        pod = (
+            make_pod("aff")
+            .pod_affinity("zone", {"app": "db"}, anti=True)
+            .obj()
+        )
+        wire = _pod_to_wire(pod)
+        terms = wire["spec"]["affinity"]["podAntiAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        ]
+        assert terms[0]["topologyKey"] == "zone"
+        assert terms[0]["labelSelector"]["matchLabels"] == {"app": "db"}

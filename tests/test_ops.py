@@ -258,3 +258,67 @@ class TestGreedyAssign:
         assert int(assignments[0]) == 0
         assert int(assignments[1]) == NO_NODE
         assert req_out[0, 0] == 1000  # inactive pod did not book capacity
+
+
+class TestFitAdviceSemantics:
+    """ADVICE round-1: the all-zero-request shortcut is per POD, not per
+    dimension (fit.go: ``allocatable < requested + request`` is checked for
+    every dimension once any request is non-zero)."""
+
+    def _solve(self, allocatable, requested, pod_req):
+        n = len(allocatable)
+        a = jnp.asarray(np.array(allocatable, dtype=np.int32))
+        r = jnp.asarray(np.array(requested, dtype=np.int32))
+        nzr = jnp.zeros((n, 2), dtype=jnp.int32)
+        valid = jnp.ones(n, dtype=bool)
+        preq = jnp.asarray(np.array([pod_req], dtype=np.int32))
+        pnzr = jnp.zeros((1, 2), dtype=jnp.int32)
+        sm = jnp.ones((1, n), dtype=bool)
+        active = jnp.ones(1, dtype=bool)
+        out, _, _ = greedy_assign(a, r, nzr, valid, preq, pnzr, sm, active)
+        return int(np.asarray(out)[0])
+
+    def test_zero_request_dim_on_overcommitted_node_rejects(self):
+        # node over-committed on cpu (nominated-pod overlay can do this);
+        # pod requests 0 cpu but >0 memory -> reference rejects
+        got = self._solve(
+            allocatable=[[1000, 1024, 0, 10]],
+            requested=[[1500, 0, 0, 1]],
+            pod_req=[0, 512, 0, 1],
+        )
+        assert got == NO_NODE
+
+    def test_all_zero_request_pod_only_checks_pod_count(self):
+        got = self._solve(
+            allocatable=[[1000, 1024, 0, 10]],
+            requested=[[1500, 0, 0, 1]],
+            pod_req=[0, 0, 0, 1],
+        )
+        assert got == 0
+
+    def test_all_zero_request_pod_rejected_when_pod_slots_full(self):
+        got = self._solve(
+            allocatable=[[1000, 1024, 0, 1]],
+            requested=[[0, 0, 0, 1]],
+            pod_req=[0, 0, 0, 1],
+        )
+        assert got == NO_NODE
+
+    def test_unrequested_scalar_on_overcommitted_node_still_fits(self):
+        # scalar columns are only checked when requested (fit.go iterates
+        # podRequest.ScalarResources); an over-committed extended resource
+        # must not reject a pod that doesn't ask for it
+        got = self._solve(
+            allocatable=[[1000, 1024, 0, 10, 4]],
+            requested=[[0, 0, 0, 1, 5]],
+            pod_req=[500, 0, 0, 1, 0],
+        )
+        assert got == 0
+
+    def test_requested_scalar_on_overcommitted_node_rejects(self):
+        got = self._solve(
+            allocatable=[[1000, 1024, 0, 10, 4]],
+            requested=[[0, 0, 0, 1, 4]],
+            pod_req=[500, 0, 0, 1, 1],
+        )
+        assert got == NO_NODE

@@ -202,3 +202,22 @@ class TestTrace:
             t.step("scoring")
             t.log_if_long(0.0)
         assert "filtering" in caplog.text and "schedule" in caplog.text
+
+
+class TestDurationParsing:
+    def test_go_style_durations(self):
+        from kubernetes_tpu.config.loader import _duration_seconds
+
+        assert _duration_seconds("30s") == 30.0
+        assert _duration_seconds("1m30s") == 90.0
+        assert _duration_seconds("500ms") == 0.5
+        assert _duration_seconds(5) == 5.0
+        assert _duration_seconds("2.5") == 2.5
+        with pytest.raises(ValueError):
+            _duration_seconds("bogus")
+
+    def test_extender_http_timeout_duration_string(self):
+        cfg = load_config_from_dict(
+            {"extenders": [{"urlPrefix": "http://x", "httpTimeout": "30s"}]}
+        )
+        assert cfg.extenders[0].http_timeout_seconds == 30.0
