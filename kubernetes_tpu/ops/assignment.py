@@ -81,8 +81,7 @@ class GreedyConfig:
     most_allocated_weight: int = 0
 
 
-@partial(jax.jit, static_argnames=("config",))
-def greedy_assign(
+def _greedy_assign_impl(
     allocatable: jnp.ndarray,  # [N, R] int32
     requested: jnp.ndarray,  # [N, R] int32 (batch-start state)
     nzr: jnp.ndarray,  # [N, 2] int32 non-zero requested (cpu, memKiB)
@@ -141,8 +140,7 @@ def greedy_assign(
     return assignments, req_out, nzr_out
 
 
-@jax.jit
-def greedy_assign_scored(
+def _greedy_assign_scored_impl(
     allocatable: jnp.ndarray,  # [N, R] int32
     requested: jnp.ndarray,  # [N, R] int32
     valid: jnp.ndarray,  # [N] bool
@@ -177,8 +175,7 @@ def greedy_assign_scored(
     return assignments, req_out
 
 
-@partial(jax.jit, static_argnames=("config",))
-def greedy_assign_spread(
+def _greedy_assign_spread_impl(
     allocatable: jnp.ndarray,  # [N, R] int32
     requested: jnp.ndarray,  # [N, R] int32
     nzr: jnp.ndarray,  # [N, 2] int32
@@ -278,6 +275,65 @@ def greedy_assign_spread(
         ),
     )
     return assignments, req_out, nzr_out, counts_out
+
+
+greedy_assign = partial(jax.jit, static_argnames=("config",))(
+    _greedy_assign_impl
+)
+greedy_assign_scored = jax.jit(_greedy_assign_scored_impl)
+greedy_assign_spread = partial(jax.jit, static_argnames=("config",))(
+    _greedy_assign_spread_impl
+)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def greedy_assign_compact(
+    allocatable: jnp.ndarray,
+    requested: jnp.ndarray,
+    nzr: jnp.ndarray,
+    valid: jnp.ndarray,
+    pod_requests: jnp.ndarray,
+    pod_nzr: jnp.ndarray,
+    mask_rows: jnp.ndarray,  # [U, N] deduplicated static-mask rows
+    mask_index: jnp.ndarray,  # [B] int32 row index per pod
+    active: jnp.ndarray,
+    config: GreedyConfig = GreedyConfig(),
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """greedy_assign with the static mask shipped deduplicated (see
+    host_masks.static_mask_compact) and expanded by an on-device gather --
+    the host->device transfer is O(U x N + B) instead of O(B x N)."""
+    return _greedy_assign_impl(
+        allocatable, requested, nzr, valid, pod_requests, pod_nzr,
+        mask_rows[mask_index], active, config=config,
+    )
+
+
+@partial(jax.jit, static_argnames=("config",))
+def greedy_assign_spread_compact(
+    allocatable: jnp.ndarray,
+    requested: jnp.ndarray,
+    nzr: jnp.ndarray,
+    valid: jnp.ndarray,
+    pod_requests: jnp.ndarray,
+    pod_nzr: jnp.ndarray,
+    mask_rows: jnp.ndarray,
+    mask_index: jnp.ndarray,
+    active: jnp.ndarray,
+    group_counts: jnp.ndarray,
+    value_valid: jnp.ndarray,
+    node_value: jnp.ndarray,
+    pod_groups: jnp.ndarray,
+    pod_max_skew: jnp.ndarray,
+    pod_self: jnp.ndarray,
+    pod_match: jnp.ndarray,
+    config: GreedyConfig = GreedyConfig(),
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    return _greedy_assign_spread_impl(
+        allocatable, requested, nzr, valid, pod_requests, pod_nzr,
+        mask_rows[mask_index], active,
+        group_counts, value_valid, node_value,
+        pod_groups, pod_max_skew, pod_self, pod_match, config=config,
+    )
 
 
 def make_sharded_solver(mesh: "jax.sharding.Mesh", config: GreedyConfig = GreedyConfig()):

@@ -76,17 +76,23 @@ def _tolerates_node_taints(pod: Pod, node) -> bool:
     return True
 
 
-def static_mask(
+def static_mask_compact(
     pods: List[Pod], snapshot: Snapshot, nt: NodeTensor
-) -> np.ndarray:
-    """[B, capacity] bool: label-level feasibility per (pod, node)."""
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Deduplicated mask: (rows [U, capacity] bool, index [B] int32) with
+    ``mask[b] == rows[index[b]]``. U = distinct constraint signatures --
+    typically a handful -- so shipping (rows, index) to the device and
+    gathering there cuts the per-batch host->device transfer from
+    O(B x N) to O(U x N + B), which matters when every transfer pays a
+    tunnel round trip."""
     infos = snapshot.list_node_infos()
-    out = np.zeros((len(pods), nt.capacity), dtype=bool)
-    cache: Dict[Tuple, np.ndarray] = {}
+    index = np.zeros(len(pods), dtype=np.int32)
+    cache: Dict[Tuple, int] = {}
+    rows: List[np.ndarray] = []
     for b, pod in enumerate(pods):
         sig = _constraint_signature(pod)
-        row = cache.get(sig)
-        if row is None:
+        u = cache.get(sig)
+        if u is None:
             row = np.zeros(nt.capacity, dtype=bool)
             # snapshot order == tensor row order (NodeTensorCache packs
             # rows from the same list)
@@ -107,6 +113,16 @@ def static_mask(
                 if not _tolerates_node_taints(pod, node):
                     continue
                 row[j] = True
-            cache[sig] = row
-        out[b] = row
-    return out
+            u = len(rows)
+            rows.append(row)
+            cache[sig] = u
+        index[b] = u
+    return np.stack(rows), index
+
+
+def static_mask(
+    pods: List[Pod], snapshot: Snapshot, nt: NodeTensor
+) -> np.ndarray:
+    """[B, capacity] bool: label-level feasibility per (pod, node)."""
+    rows, index = static_mask_compact(pods, snapshot, nt)
+    return rows[index]

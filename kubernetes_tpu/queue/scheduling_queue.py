@@ -225,19 +225,32 @@ class PriorityQueue:
             return pi
 
     def pop_batch(
-        self, max_size: int, timeout: Optional[float] = None
+        self,
+        max_size: int,
+        timeout: Optional[float] = None,
+        window: float = 0.0,
     ) -> List[PodInfo]:
         """TPU batch drain: block for the first pod, then take up to
-        ``max_size`` without blocking. One scheduling cycle per batch."""
+        ``max_size``. With ``window > 0``, wait up to that long for more
+        arrivals before returning a partial batch -- amortizes the fixed
+        per-solve cost (device transfer + dispatch) during a burst at the
+        price of a bounded latency add for the first pods."""
         first = self.pop(timeout=timeout)
         if first is None:
             return []
         batch = [first]
+        deadline = self._now() + window
         with self._cond:
-            while len(batch) < max_size and len(self.active_q) > 0:
-                pi: PodInfo = self.active_q.pop()
-                pi.attempts += 1
-                batch.append(pi)
+            while len(batch) < max_size:
+                if len(self.active_q) > 0:
+                    pi: PodInfo = self.active_q.pop()
+                    pi.attempts += 1
+                    batch.append(pi)
+                    continue
+                remaining = deadline - self._now()
+                if remaining <= 0 or self._closed:
+                    break
+                self._cond.wait(remaining)
         return batch
 
     # -- move machinery -----------------------------------------------------
@@ -275,6 +288,53 @@ class PriorityQueue:
     def unschedulable_pods(self) -> List[PodInfo]:
         with self._lock:
             return list(self.unschedulable_q.values())
+
+    # -- targeted assigned-pod wakeups (reference :508-:525) ----------------
+
+    def _pods_with_matching_affinity_term(self, pod: Pod) -> List[PodInfo]:
+        """getUnschedulablePodsWithMatchingAffinityTerm
+        (scheduling_queue.go:560): unschedulable pods whose pod-AFFINITY
+        terms match the newly assigned pod -- only those can become
+        schedulable because of it."""
+        from kubernetes_tpu.api.selectors import labels_match_selector
+
+        out = []
+        with self._lock:
+            for pi in self.unschedulable_q.values():
+                a = pi.pod.spec.affinity
+                if a is None or a.pod_affinity is None:
+                    continue
+                terms = list(a.pod_affinity.required_during_scheduling) + [
+                    w.pod_affinity_term
+                    for w in a.pod_affinity.preferred_during_scheduling
+                ]
+                for term in terms:
+                    namespaces = term.namespaces or [pi.pod.metadata.namespace]
+                    if pod.metadata.namespace in namespaces and (
+                        labels_match_selector(
+                            pod.metadata.labels, term.label_selector
+                        )
+                    ):
+                        out.append(pi)
+                        break
+        return out
+
+    def assigned_pod_added(self, pod: Pod) -> None:
+        """Reference :508 AssignedPodAdded: an added pod can only help
+        parked pods whose affinity terms it matches. The move runs even
+        with an empty match list: it bumps move_request_cycle, which is
+        the lost-wakeup guard for pods mid-attempt right now (they requeue
+        to backoff instead of parking unschedulable)."""
+        self.move_pods_to_active_or_backoff_queue(
+            self._pods_with_matching_affinity_term(pod), events.AssignedPodAdd
+        )
+
+    def assigned_pod_updated(self, pod: Pod) -> None:
+        """Reference :516 AssignedPodUpdated."""
+        self.move_pods_to_active_or_backoff_queue(
+            self._pods_with_matching_affinity_term(pod),
+            events.AssignedPodUpdate,
+        )
 
     # -- flush loops (reference :234-237 run goroutines) --------------------
 

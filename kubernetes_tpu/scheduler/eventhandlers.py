@@ -41,9 +41,11 @@ def add_all_event_handlers(
             sched.cache.add_pod(pod)
         except Exception:
             logger.exception("add pod %s to cache", pod.key())
-        # Waking pods with matching affinity terms; moving all is a
-        # conservative superset of AssignedPodAdded (eventhandlers.go:90).
-        sched.queue.move_all_to_active_or_backoff_queue(events.AssignedPodAdd)
+        # Targeted wake: only parked pods whose affinity terms match the
+        # added pod can benefit (eventhandlers.go:90 assignedPodAdded ->
+        # scheduling_queue.go:508). During a 10k-burst the cache sees one
+        # add per bound pod; a move-all here is O(pods x unschedulable).
+        sched.queue.assigned_pod_added(pod)
 
     def update_pod_in_cache(old: Pod, new: Pod) -> None:
         try:
@@ -52,7 +54,7 @@ def add_all_event_handlers(
             sched.cache.add_pod(new)
         except Exception:
             logger.exception("update pod %s in cache", new.key())
-        sched.queue.move_all_to_active_or_backoff_queue(events.AssignedPodUpdate)
+        sched.queue.assigned_pod_updated(new)
 
     def delete_pod_from_cache(pod: Pod) -> None:
         try:
@@ -74,12 +76,20 @@ def add_all_event_handlers(
     def add_pod_to_queue(pod: Pod) -> None:
         sched.queue.add(pod)
         # a new gang member can unblock siblings rejected by the
-        # coscheduling fail-fast (total < minMember) -- wake them
+        # coscheduling fail-fast (total < minMember) -- wake exactly them
         from kubernetes_tpu.api.types import POD_GROUP_LABEL
 
-        if pod.metadata.labels.get(POD_GROUP_LABEL):
-            sched.queue.move_all_to_active_or_backoff_queue(
-                "PodGroupMemberAdd"
+        group = pod.metadata.labels.get(POD_GROUP_LABEL)
+        if group:
+            siblings = [
+                pi
+                for pi in sched.queue.unschedulable_pods()
+                if pi.pod.metadata.labels.get(POD_GROUP_LABEL) == group
+            ]
+            # run even with no parked sibling: the move_request_cycle bump
+            # covers siblings mid-attempt right now (lost-wakeup guard)
+            sched.queue.move_pods_to_active_or_backoff_queue(
+                siblings, "PodGroupMemberAdd"
             )
 
     def update_pod_in_queue(old: Pod, new: Pod) -> None:

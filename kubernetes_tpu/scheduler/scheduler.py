@@ -227,20 +227,20 @@ class Scheduler:
             prof, state, pod_info, result.suggested_host, pod_scheduling_cycle
         )
 
-    def finish_schedule(
+    def reserve_assume_permit(
         self,
         prof: Framework,
         state: CycleState,
         pod_info: PodInfo,
         host: str,
         pod_scheduling_cycle: int,
-    ) -> None:
-        """Post-decision pipeline (scheduler.go:615-738): Reserve ->
-        assume -> Permit -> async binding cycle. Shared by the sequential
-        path and the TPU batch solver (which replaces only the
-        filter/score/select stage)."""
+    ) -> Optional[Pod]:
+        """First half of the post-decision pipeline (scheduler.go:615-660):
+        Reserve -> assume -> Permit. Returns the assumed pod on success
+        (possibly parked in the Permit waiting map), None after a recorded
+        failure. Shared by the sequential path and the batch commit."""
         pod = pod_info.pod
-        assumed = pod.deepcopy()
+        assumed = pod.assumed_clone()
 
         # Reserve
         status = prof.run_reserve_plugins(state, assumed, host)
@@ -249,7 +249,7 @@ class Scheduler:
                 prof, pod_info, status.message(), "SchedulerError", "",
                 pod_scheduling_cycle,
             )
-            return
+            return None
 
         # Assume: the pod occupies the node in cache from here on.
         try:
@@ -259,7 +259,7 @@ class Scheduler:
             self.record_scheduling_failure(
                 prof, pod_info, str(e), "SchedulerError", "", pod_scheduling_cycle
             )
-            return
+            return None
 
         # Permit
         status = prof.run_permit_plugins(state, assumed, host)
@@ -276,6 +276,25 @@ class Scheduler:
             self.record_scheduling_failure(
                 prof, pod_info, status.message(), reason, "", pod_scheduling_cycle
             )
+            return None
+        return assumed
+
+    def finish_schedule(
+        self,
+        prof: Framework,
+        state: CycleState,
+        pod_info: PodInfo,
+        host: str,
+        pod_scheduling_cycle: int,
+    ) -> None:
+        """Post-decision pipeline (scheduler.go:615-738): Reserve ->
+        assume -> Permit -> async binding cycle. Shared by the sequential
+        path and the TPU batch solver (which replaces only the
+        filter/score/select stage)."""
+        assumed = self.reserve_assume_permit(
+            prof, state, pod_info, host, pod_scheduling_cycle
+        )
+        if assumed is None:
             return
 
         # Binding cycle: async goroutine in the reference (scheduler.go:666).
@@ -351,6 +370,16 @@ class Scheduler:
                 pod_scheduling_cycle,
             )
             return
+        self._record_bind_success(prof, state, pod_info, assumed, host)
+
+    def _record_bind_success(
+        self,
+        prof: Framework,
+        state: CycleState,
+        pod_info: PodInfo,
+        assumed: Pod,
+        host: str,
+    ) -> None:
         prof.run_post_bind_plugins(state, assumed, host)
         metrics.schedule_attempts.inc(result="scheduled")
         metrics.pod_scheduling_attempts.observe(pod_info.attempts)
