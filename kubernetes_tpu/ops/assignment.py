@@ -308,63 +308,221 @@ def greedy_assign_compact(
     )
 
 
-@partial(jax.jit, static_argnames=("config",))
-def greedy_assign_spread_compact(
-    allocatable: jnp.ndarray,
-    requested: jnp.ndarray,
-    nzr: jnp.ndarray,
-    valid: jnp.ndarray,
-    pod_requests: jnp.ndarray,
-    pod_nzr: jnp.ndarray,
-    mask_rows: jnp.ndarray,
-    mask_index: jnp.ndarray,
-    active: jnp.ndarray,
-    group_counts: jnp.ndarray,
-    value_valid: jnp.ndarray,
-    node_value: jnp.ndarray,
-    pod_groups: jnp.ndarray,
-    pod_max_skew: jnp.ndarray,
-    pod_self: jnp.ndarray,
-    pod_match: jnp.ndarray,
-    config: GreedyConfig = GreedyConfig(),
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    return _greedy_assign_spread_impl(
-        allocatable, requested, nzr, valid, pod_requests, pod_nzr,
-        mask_rows[mask_index], active,
-        group_counts, value_valid, node_value,
-        pod_groups, pod_max_skew, pod_self, pod_match, config=config,
+def affinity_node_ok(
+    counts_aff: jnp.ndarray,  # [Ra, V]
+    counts_anti: jnp.ndarray,  # [Rt, V]
+    counts_exist: jnp.ndarray,  # [Re, V]
+    vals_aff: jnp.ndarray,  # [Ra, N] per-row node values (-1 absent)
+    vals_anti: jnp.ndarray,  # [Rt, N]
+    vals_exist: jnp.ndarray,  # [Re, N]
+    aff_rows: jnp.ndarray,  # [C] the pod's affinity rows (-1 pad)
+    self_match: jnp.ndarray,  # [] bool
+    anti_rows: jnp.ndarray,  # [C]
+    exist_match: jnp.ndarray,  # [Re] bool
+) -> jnp.ndarray:
+    """The three required-affinity Filter checks for ONE pod against all
+    nodes, straight from interpodaffinity/filtering.go -- shared by the
+    constrained scan and the differential tests. Returns [N] bool."""
+    v = counts_aff.shape[1]
+
+    # incoming affinity: every term's pair positive
+    # (nodeMatchesAllTopologyTerms :420)
+    aff_cnt = jnp.take_along_axis(
+        counts_aff, jnp.clip(vals_aff, 0, v - 1), axis=1
+    )  # [Ra, N]
+    aff_pos = (vals_aff >= 0) & (aff_cnt > 0)
+    safe_rows = jnp.clip(aff_rows, 0)
+    row_ok = aff_pos[safe_rows]  # [C, N]
+    aff_all = jnp.where((aff_rows >= 0)[:, None], row_ok, True).all(0)
+    # first-pod escape (filtering.go:494): no match anywhere for the
+    # pod's term-set AND the pod matches its own terms
+    row_tot = counts_aff.sum(axis=1)
+    total = jnp.sum(row_tot[safe_rows] * (aff_rows >= 0))
+    aff_ok = aff_all | ((total == 0) & self_match)
+
+    # incoming anti-affinity: any positive pair blocks
+    # (nodeMatchesAnyTopologyTerm :437)
+    anti_cnt = jnp.take_along_axis(
+        counts_anti, jnp.clip(vals_anti, 0, v - 1), axis=1
     )
+    anti_bad = (vals_anti >= 0) & (anti_cnt > 0)
+    safe_anti = jnp.clip(anti_rows, 0)
+    bad = jnp.where(
+        (anti_rows >= 0)[:, None], anti_bad[safe_anti], False
+    ).any(0)
+
+    # existing pods' anti-affinity (:404)
+    exist_cnt = jnp.take_along_axis(
+        counts_exist, jnp.clip(vals_exist, 0, v - 1), axis=1
+    )
+    exist_bad = (vals_exist >= 0) & (exist_cnt > 0)
+    blocked = (exist_match[:, None] & exist_bad).any(0)
+
+    return aff_ok & ~bad & ~blocked
 
 
-def make_sharded_solver(mesh: "jax.sharding.Mesh", config: GreedyConfig = GreedyConfig()):
-    """Build a node-axis-sharded greedy solver for a device mesh.
+def row_node_values(
+    node_value: jnp.ndarray, row_key: jnp.ndarray
+) -> jnp.ndarray:
+    """[R, N] per-row node values: -1 where the node lacks the row's
+    topology key or the row is padding."""
+    vals = node_value[jnp.clip(row_key, 0), :]
+    return jnp.where(row_key[:, None] >= 0, vals, -1)
 
-    Sharding layout (SURVEY.md section 2.5: data parallelism over the node
-    axis, the TPU analogue of ParallelizeUntil's 16 goroutines): every
-    ``[N, ...]`` operand is split over the ``nodes`` mesh axis, pod-batch
-    operands are replicated, and XLA inserts the ICI collectives for the
-    cross-shard argmax inside the scan. N must be a multiple of the mesh
-    size (NodeTensorCache pads to 128 rows).
+
+@partial(jax.jit, static_argnames=("config",))
+def greedy_assign_constrained(
+    allocatable: jnp.ndarray,  # [N, R] int32
+    requested: jnp.ndarray,  # [N, R] int32
+    nzr: jnp.ndarray,  # [N, 2] int32
+    valid: jnp.ndarray,  # [N] bool
+    pod_requests: jnp.ndarray,  # [B, R] int32, solve order
+    pod_nzr: jnp.ndarray,  # [B, 2] int32
+    mask_rows: jnp.ndarray,  # [U, N] deduplicated static-mask rows
+    mask_index: jnp.ndarray,  # [B] int32
+    active: jnp.ndarray,  # [B] bool
+    spread: Tuple[jnp.ndarray, ...],
+    affinity: Tuple[jnp.ndarray, ...],
+    config: GreedyConfig = GreedyConfig(),
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The full constrained assignment scan: NodeResourcesFit + static
+    label mask + hard topology spread (ops/topology.py) + required pod
+    (anti-)affinity (ops/affinity.py), with every constraint family's
+    count tensors replayed in the scan carry so within-batch interactions
+    match the sequential addNominatedPods semantics
+    (interpodaffinity/filtering.go:75 updateWithPod,
+    podtopologyspread/filtering.go:127 updateWithPod).
+
+    ``spread``: (group_counts [G,V], value_valid [G,V], node_value [G,N],
+    pod_groups [B,C], pod_max_skew [B,C], pod_self [B,C], pod_match [B,G])
+    -- all-zero/-1 tensors make it a no-op.
+
+    ``affinity``: the AffinityBatch arrays (ops/affinity.py docstring) --
+    zero counts + all -1 rows make it a no-op.
     """
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    (sp_counts0, sp_value_valid, sp_node_value,
+     sp_pod_groups, sp_pod_max_skew, sp_pod_self, sp_pod_match) = spread
+    (af_node_value, af_counts_aff0, af_row_key_aff, af_pod_aff_rows,
+     af_pod_self_match, af_pod_bump_aff,
+     af_counts_anti0, af_row_key_anti, af_pod_anti_rows, af_pod_bump_anti,
+     af_counts_exist0, af_row_key_exist, af_pod_exist_match,
+     af_pod_bump_exist) = affinity
 
-    node = NamedSharding(mesh, P("nodes"))
-    node2d = NamedSharding(mesh, P("nodes", None))
-    batch_by_node = NamedSharding(mesh, P(None, "nodes"))
-    repl = NamedSharding(mesh, P())
+    static_mask = mask_rows[mask_index]
+    caps = allocatable[:, :2]
+    n = allocatable.shape[0]
+    node_iota = jnp.arange(n, dtype=jnp.int32)
+    g_count = sp_counts0.shape[0]
+    group_iota = jnp.arange(g_count, dtype=jnp.int32)
+    big = jnp.int32(1 << 20)
+    v_aff = af_counts_aff0.shape[1]
 
-    def solve(allocatable, requested, nzr, valid, pod_requests, pod_nzr,
-              static_mask, active):
-        return greedy_assign(
-            allocatable, requested, nzr, valid,
-            pod_requests, pod_nzr, static_mask, active, config=config,
+    # per-row node values are static for the batch (rows bind to one
+    # topology key each); -1 marks "node lacks the key" / padding rows
+    vals_aff = row_node_values(af_node_value, af_row_key_aff)  # [Ra, N]
+    vals_anti = row_node_values(af_node_value, af_row_key_anti)  # [Rt, N]
+    vals_exist = row_node_values(af_node_value, af_row_key_exist)  # [Re, N]
+    ra = jnp.arange(vals_aff.shape[0])
+    rt = jnp.arange(vals_anti.shape[0])
+    re_ = jnp.arange(vals_exist.shape[0])
+
+    def step(carry, inputs):
+        (req_state, nzr_state, sp_counts,
+         counts_aff, counts_anti, counts_exist) = carry
+        (pod_req, p_nzr, smask, is_active,
+         groups, skews, selfs, match,
+         aff_rows, self_match, bump_aff,
+         anti_rows, bump_anti, exist_match, bump_exist) = inputs
+
+        free = allocatable - req_state
+        fits = _fits(free, pod_req)
+        feasible = fits & smask & valid
+
+        # -- topology spread (filtering.go:322 skew rule) -------------------
+        def one_constraint(c):
+            g = groups[c]
+            safe_g = jnp.maximum(g, 0)
+            counts_g = sp_counts[safe_g]
+            min_v = jnp.min(jnp.where(sp_value_valid[safe_g], counts_g, big))
+            vals = sp_node_value[safe_g]
+            node_count = counts_g[jnp.clip(vals, 0, counts_g.shape[0] - 1)]
+            ok = (vals >= 0) & (node_count + selfs[c] - min_v <= skews[c])
+            return jnp.where(g >= 0, ok, jnp.ones_like(ok))
+
+        spread_ok = jax.vmap(one_constraint)(
+            jnp.arange(groups.shape[0])
+        ).all(axis=0)
+
+        aff_ok = affinity_node_ok(
+            counts_aff, counts_anti, counts_exist,
+            vals_aff, vals_anti, vals_exist,
+            aff_rows, self_match, anti_rows, exist_match,
         )
 
-    return jax.jit(
-        solve,
-        in_shardings=(
-            node2d, node2d, node2d, node,  # node-axis state
-            repl, repl, batch_by_node, repl,  # pod batch
-        ),
-        out_shardings=(repl, node2d, node2d),
+        feasible = feasible & spread_ok & aff_ok
+
+        score = jnp.zeros((n,), dtype=jnp.float32)
+        if config.least_allocated_weight:
+            score += config.least_allocated_weight * least_allocated_score(
+                caps, nzr_state, p_nzr[None, :]
+            )[0]
+        if config.balanced_allocation_weight:
+            score += (
+                config.balanced_allocation_weight
+                * balanced_allocation_score(caps, nzr_state, p_nzr[None, :])[0]
+            )
+        if config.most_allocated_weight:
+            score += config.most_allocated_weight * most_allocated_score(
+                caps, nzr_state, p_nzr[None, :]
+            )[0]
+
+        score = jnp.where(feasible, score, -jnp.inf)
+        choice = jnp.argmax(score).astype(jnp.int32)
+        placed = feasible.any() & is_active
+        assignment = jnp.where(placed, choice, NO_NODE)
+
+        chosen = (node_iota == choice) & placed
+        req_state = req_state + chosen[:, None] * pod_req[None, :]
+        nzr_state = nzr_state + chosen[:, None] * p_nzr[None, :]
+
+        # spread count replay
+        vals_at_choice = sp_node_value[:, choice]
+        sp_bump = (
+            placed & (vals_at_choice >= 0) & (match > 0)
+        ).astype(jnp.int32)
+        sp_counts = sp_counts.at[
+            group_iota, jnp.clip(vals_at_choice, 0, sp_counts.shape[1] - 1)
+        ].add(sp_bump)
+
+        # affinity count replay (updateWithPod :75 generalized)
+        placed_i = placed.astype(jnp.int32)
+        va = vals_aff[:, choice]
+        counts_aff = counts_aff.at[ra, jnp.clip(va, 0)].add(
+            bump_aff * (va >= 0) * placed_i
+        )
+        vt = vals_anti[:, choice]
+        counts_anti = counts_anti.at[rt, jnp.clip(vt, 0)].add(
+            bump_anti * (vt >= 0) * placed_i
+        )
+        ve = vals_exist[:, choice]
+        counts_exist = counts_exist.at[re_, jnp.clip(ve, 0)].add(
+            bump_exist * (ve >= 0) * placed_i
+        )
+
+        carry = (req_state, nzr_state, sp_counts,
+                 counts_aff, counts_anti, counts_exist)
+        return carry, assignment
+
+    carry0 = (requested, nzr, sp_counts0,
+              af_counts_aff0, af_counts_anti0, af_counts_exist0)
+    xs = (
+        pod_requests, pod_nzr, static_mask, active,
+        sp_pod_groups, sp_pod_max_skew, sp_pod_self, sp_pod_match,
+        af_pod_aff_rows, af_pod_self_match, af_pod_bump_aff,
+        af_pod_anti_rows, af_pod_bump_anti, af_pod_exist_match,
+        af_pod_bump_exist,
     )
+    (req_out, nzr_out, _, _, _, _), assignments = jax.lax.scan(
+        step, carry0, xs
+    )
+    return assignments, req_out, nzr_out

@@ -217,3 +217,38 @@ def pack_spread_batch(
     )
 
 
+
+
+def noop_spread_tensors(padded: int, n_cap: int):
+    """All-inactive spread tensors (kernel no-op), in
+    greedy_assign_constrained argument order."""
+    return (
+        np.zeros((MAX_GROUPS, MAX_VALUES), dtype=np.int32),
+        np.zeros((MAX_GROUPS, MAX_VALUES), dtype=bool),
+        np.full((MAX_GROUPS, n_cap), -1, dtype=np.int32),
+        np.full((padded, MAX_CONSTRAINTS_PER_POD), -1, dtype=np.int32),
+        np.zeros((padded, MAX_CONSTRAINTS_PER_POD), dtype=np.int32),
+        np.zeros((padded, MAX_CONSTRAINTS_PER_POD), dtype=np.int32),
+        np.zeros((padded, MAX_GROUPS), dtype=np.int32),
+    )
+
+
+def pad_spread_tensors(sp: SpreadBatch, padded: int):
+    """Pad the per-pod arrays (already in solve order) to the fixed batch
+    axis."""
+    b = sp.pod_groups.shape[0]
+
+    def pad_pods(a, fill):
+        out = np.full((padded,) + a.shape[1:], fill, dtype=a.dtype)
+        out[:b] = a
+        return out
+
+    return (
+        sp.group_counts,
+        sp.value_valid,
+        sp.node_value,
+        pad_pods(sp.pod_groups, -1),
+        pad_pods(sp.pod_max_skew, 0),
+        pad_pods(sp.pod_self, 0),
+        pad_pods(sp.pod_match, 0),
+    )

@@ -36,14 +36,22 @@ from kubernetes_tpu.ops.assignment import (
     GreedyConfig,
     NO_NODE,
     greedy_assign_compact,
-    greedy_assign_spread_compact,
+    greedy_assign_constrained,
+)
+from kubernetes_tpu.ops.affinity import (
+    batch_has_affinity,
+    batch_has_required_anti_affinity,
+    cluster_has_required_anti_affinity,
+    noop_affinity_tensors,
+    pack_affinity_batch,
+    pad_affinity_tensors,
+    pod_has_preferred_affinity,
 )
 from kubernetes_tpu.ops.host_masks import static_mask_compact
 from kubernetes_tpu.ops.topology import (
-    MAX_CONSTRAINTS_PER_POD,
-    MAX_GROUPS,
-    MAX_VALUES,
+    noop_spread_tensors,
     pack_spread_batch,
+    pad_spread_tensors,
 )
 from kubernetes_tpu.scheduler.generic import SNAPSHOT_STATE_KEY
 from kubernetes_tpu.scheduler.scheduler import Scheduler
@@ -75,10 +83,10 @@ def solver_supported(pod: Pod) -> bool:
         )
     ):
         return False
-    a = spec.affinity
-    if a is not None and (
-        a.pod_affinity is not None or a.pod_anti_affinity is not None
-    ):
+    # REQUIRED pod (anti-)affinity solves on device via the count-tensor
+    # replay (ops/affinity.py); preferred terms shape scoring, which the
+    # device scorer set doesn't include yet
+    if pod_has_preferred_affinity(pod):
         return False
     for c in spec.containers:
         for p in c.ports:
@@ -101,26 +109,13 @@ _AVOID_PODS_ANNOTATION = "scheduler.alpha.kubernetes.io/preferAvoidPods"
 def cluster_solver_compatible(snapshot) -> bool:
     """Cluster-level conditions the device solver can't express yet.
 
-    (1) Existing pods with REQUIRED anti-affinity impose symmetric hard
-    constraints on incoming pods that have no affinity of their own
-    (interpodaffinity filtering.go:404 satisfiesExistingPodsAntiAffinity);
-    the static mask doesn't model them, so their presence forces the
-    sequential path. Preferred-only (anti-)affinity on existing pods is a
-    score divergence, not a correctness one, and does not disable batching.
-
-    (2) The preferAvoidPods annotation scores at weight 10000 -- a
-    near-hard exclusion sequentially -- which the device scorer set
-    doesn't include.
+    Existing pods' required anti-affinity is now modeled on device (the
+    exist-row tensors, ops/affinity.py), so the only remaining gate is the
+    preferAvoidPods annotation: it scores at weight 10000 -- a near-hard
+    exclusion sequentially -- which the device scorer set doesn't include.
+    Preferred-only (anti-)affinity on existing pods is a score divergence,
+    not a correctness one, and does not disable batching.
     """
-    for ni in snapshot.have_pods_with_affinity_list:
-        for p in ni.pods_with_affinity:
-            a = p.spec.affinity
-            if (
-                a is not None
-                and a.pod_anti_affinity is not None
-                and a.pod_anti_affinity.required_during_scheduling
-            ):
-                return False
     for ni in snapshot.list_node_infos():
         if (
             ni.node is not None
@@ -288,8 +283,16 @@ class BatchScheduler(Scheduler):
         incompatible clusters) drain the pipeline first."""
         pods = [pi.pod for pi in solver_infos]
         has_spread = any(p.spec.topology_spread_constraints for p in pods)
+        has_affinity = batch_has_affinity(pods)
+        has_required_anti = batch_has_required_anti_affinity(pods)
         nominated_by_node = self.queue.all_nominated_pods_by_node()
-        if self._pending is not None and (has_spread or nominated_by_node):
+        if self._pending is not None and (
+            has_spread or has_affinity or nominated_by_node
+            # an in-flight batch carrying required anti-affinity imposes
+            # symmetric constraints this batch can only see once its
+            # placements are committed to the host cache
+            or self._pending.get("has_required_anti")
+        ):
             self._drain_pending()
             # the drain can assume previously nominated pods (dropping
             # their nomination) and nominate new ones via preemption --
@@ -298,6 +301,16 @@ class BatchScheduler(Scheduler):
 
         snapshot = self.algorithm.snapshot
         self.cache.update_snapshot(snapshot)
+        # existing pods with required anti-affinity constrain EVERY
+        # incoming pod symmetrically (filtering.go:404) -- such clusters
+        # need the affinity tensors even for batches without affinity, and
+        # their counts must include any in-flight placements
+        if not has_affinity and cluster_has_required_anti_affinity(snapshot):
+            has_affinity = True
+            if self._pending is not None:
+                self._drain_pending()
+                self.cache.update_snapshot(snapshot)
+                nominated_by_node = self.queue.all_nominated_pods_by_node()
         if not cluster_solver_compatible(snapshot):
             # a fallback pod placed earlier in this batch (or informer
             # churn) introduced constraints the device can't model yet
@@ -363,13 +376,23 @@ class BatchScheduler(Scheduler):
         rows[:u] = mask_rows
 
         # hard topology-spread constraints solve on device via the
-        # group-count scan (ops/topology.py)
+        # group-count scan (ops/topology.py); required (anti-)affinity via
+        # the count-tensor replay (ops/affinity.py)
         spread = None
-        if has_spread:
+        affinity = None
+        if has_spread or has_affinity:
             ordered_pods = [pods[int(i)] for i in order]
+        if has_spread:
             spread = pack_spread_batch(ordered_pods, snapshot, nt)
             if spread is None:
                 # envelope exceeded: host path keeps full correctness
+                for pi in solver_infos:
+                    self.pods_fallback += 1
+                    self.attempt_schedule(pi)
+                return None
+        if has_affinity:
+            affinity = pack_affinity_batch(ordered_pods, snapshot, nt)
+            if affinity is None:
                 for pi in solver_infos:
                     self.pods_fallback += 1
                     self.attempt_schedule(pi)
@@ -434,28 +457,26 @@ class BatchScheduler(Scheduler):
             ds.alloc_dev, req_state_d, nzr_state_d, ds.valid_dev,
             req_d, nzr_d, rows_d, midx_d, active_d,
         )
-        if spread is None:
+        if spread is None and affinity is None:
             assignments_dev, req_out, nzr_out = greedy_assign_compact(
                 *common_args, config=self.solver_config
             )
         else:
-            c = spread.pod_groups.shape[1]
-            pg = np.full((padded, c), -1, dtype=np.int32)
-            ps = np.zeros((padded, c), dtype=np.int32)
-            pm = np.zeros((padded, spread.pod_match.shape[1]), dtype=np.int32)
-            pg[:b] = spread.pod_groups
-            ps[:b] = spread.pod_self
-            pm[:b] = spread.pod_match
-            sk = np.zeros((padded, c), dtype=np.int32)
-            sk[:b] = spread.pod_max_skew
-            spread_dev = jax.device_put(
-                (
-                    spread.group_counts, spread.value_valid,
-                    spread.node_value, pg, sk, ps, pm,
-                )
-            )
-            assignments_dev, req_out, nzr_out, _ = greedy_assign_spread_compact(
-                *common_args, *spread_dev, config=self.solver_config
+            # the packers saw the pods already in solve order
+            if spread is not None:
+                sp_tensors = pad_spread_tensors(spread, padded)
+            else:
+                sp_tensors = noop_spread_tensors(padded, nt.capacity)
+            if affinity is not None:
+                af_tensors = pad_affinity_tensors(affinity, padded)
+            else:
+                af_tensors = noop_affinity_tensors(padded, nt.capacity)
+            # common_args carries (mask_rows, mask_index) in compact form;
+            # the constrained kernel takes the same layout
+            sp_dev, af_dev = jax.device_put((sp_tensors, af_tensors))
+            assignments_dev, req_out, nzr_out = greedy_assign_constrained(
+                *common_args, tuple(sp_dev), tuple(af_dev),
+                config=self.solver_config,
             )
         # start the result transfer now so it overlaps host commit work
         try:
@@ -472,6 +493,7 @@ class BatchScheduler(Scheduler):
         return {
             # copy: the caller's list is cleared after dispatch returns
             "solver_infos": list(solver_infos),
+            "has_required_anti": has_required_anti,
             "order": order,
             "assignments_dev": assignments_dev,
             "req": req,
@@ -664,17 +686,14 @@ class BatchScheduler(Scheduler):
         common = (alloc, req_state, nzr_state, valid, req, nzr, rows, midx, active)
         out = greedy_assign_compact(*common, config=self.solver_config)
         jax.block_until_ready(out)
-        c = MAX_CONSTRAINTS_PER_POD
-        out = greedy_assign_spread_compact(
-            *common,
-            jnp.zeros((MAX_GROUPS, MAX_VALUES), dtype=jnp.int32),
-            jnp.zeros((MAX_GROUPS, MAX_VALUES), dtype=bool),
-            jnp.full((MAX_GROUPS, n), -1, dtype=jnp.int32),
-            jnp.full((padded, c), -1, dtype=jnp.int32),
-            jnp.zeros((padded, c), dtype=jnp.int32),
-            jnp.zeros((padded, c), dtype=jnp.int32),
-            jnp.zeros((padded, MAX_GROUPS), dtype=jnp.int32),
-            config=self.solver_config,
+        sp_dev, af_dev = jax.device_put(
+            (
+                noop_spread_tensors(padded, n),
+                noop_affinity_tensors(padded, n),
+            )
+        )
+        out = greedy_assign_constrained(
+            *common, tuple(sp_dev), tuple(af_dev), config=self.solver_config
         )
         jax.block_until_ready(out)
 

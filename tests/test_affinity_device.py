@@ -1,0 +1,304 @@
+"""Device-side InterPodAffinity: differential tests against the host
+oracle plugin (the strongest parity check, SURVEY.md section 4 tier 5) and
+end-to-end within-batch behavior on the BatchScheduler."""
+
+import random
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.cache.snapshot import new_snapshot
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.framework.interface import CycleState
+from kubernetes_tpu.ops.affinity import pack_affinity_batch
+from kubernetes_tpu.ops.assignment import affinity_node_ok, row_node_values
+from kubernetes_tpu.plugins.interpodaffinity import InterPodAffinity
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.tensors import NodeTensorCache
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _device_feasible(af, b_index, n_cap):
+    """The scan's affinity feasibility for pod ``b_index`` against the
+    INITIAL counts (i.e. before any batch placement) -- exactly what the
+    sequential PreFilter+Filter computes."""
+    vals_aff = row_node_values(
+        jnp.asarray(af.node_value), jnp.asarray(af.row_key_aff)
+    )
+    vals_anti = row_node_values(
+        jnp.asarray(af.node_value), jnp.asarray(af.row_key_anti)
+    )
+    vals_exist = row_node_values(
+        jnp.asarray(af.node_value), jnp.asarray(af.row_key_exist)
+    )
+    ok = affinity_node_ok(
+        jnp.asarray(af.counts_aff),
+        jnp.asarray(af.counts_anti),
+        jnp.asarray(af.counts_exist),
+        vals_aff, vals_anti, vals_exist,
+        jnp.asarray(af.pod_aff_rows[b_index]),
+        jnp.asarray(af.pod_self_match[b_index]),
+        jnp.asarray(af.pod_anti_rows[b_index]),
+        jnp.asarray(af.pod_exist_match[b_index]),
+    )
+    return np.asarray(ok)[:n_cap]
+
+
+def _oracle_feasible(pod, snapshot):
+    plugin = InterPodAffinity()
+    state = CycleState()
+    state.write("__snapshot__", snapshot)
+    plugin.pre_filter(state, pod)
+    out = {}
+    for ni in snapshot.list_node_infos():
+        out[ni.node_name] = plugin.filter(state, pod, ni) is None
+    return out
+
+
+def _random_cluster(rng, num_nodes=10, num_existing=25):
+    apps = ["web", "db", "cache", "batch"]
+    nodes = [
+        make_node(f"n{i}")
+        .labels(zone=f"z{i % 3}", rack=f"r{i % 2}")
+        .capacity(cpu="16", memory="32Gi")
+        .obj()
+        for i in range(num_nodes)
+    ]
+    existing = []
+    for i in range(num_existing):
+        p = (
+            make_pod(f"e{i}")
+            .node(f"n{rng.randrange(num_nodes)}")
+            .labels(app=rng.choice(apps))
+            .container(cpu="100m", memory="128Mi")
+        )
+        roll = rng.random()
+        if roll < 0.2:
+            p = p.pod_affinity(
+                rng.choice(["zone", "rack"]),
+                {"app": rng.choice(apps)},
+                anti=True,
+            )
+        elif roll < 0.3:
+            p = p.pod_affinity("zone", {"app": rng.choice(apps)})
+        existing.append(p.obj())
+    return existing, nodes
+
+
+def _random_batch(rng, count=12):
+    apps = ["web", "db", "cache", "batch"]
+    out = []
+    for i in range(count):
+        p = (
+            make_pod(f"p{i}")
+            .labels(app=rng.choice(apps))
+            .container(cpu="100m", memory="128Mi")
+        )
+        roll = rng.random()
+        if roll < 0.35:
+            p = p.pod_affinity(
+                rng.choice(["zone", "rack"]), {"app": rng.choice(apps)}
+            )
+        elif roll < 0.7:
+            p = p.pod_affinity(
+                rng.choice(["zone", "rack"]),
+                {"app": rng.choice(apps)},
+                anti=True,
+            )
+        if 0.3 < roll < 0.45:
+            p = p.pod_affinity("rack", {"app": rng.choice(apps)}, anti=True)
+        out.append(p.obj())
+    return out
+
+
+class TestAffinityPackParity:
+    @pytest.mark.parametrize("seed", [1, 7, 42, 99])
+    def test_initial_feasibility_matches_oracle(self, seed):
+        rng = random.Random(seed)
+        existing, nodes = _random_cluster(rng)
+        snap = new_snapshot(existing, nodes)
+        nt = NodeTensorCache().update(snap)
+        batch = _random_batch(rng)
+        af = pack_affinity_batch(batch, snap, nt)
+        assert af is not None
+        for b, pod in enumerate(batch):
+            want = _oracle_feasible(pod, snap)
+            got = _device_feasible(af, b, nt.capacity)
+            for ni in snap.list_node_infos():
+                j = nt.row(ni.node_name)
+                assert bool(got[j]) == want[ni.node_name], (
+                    f"seed={seed} pod={pod.metadata.name} "
+                    f"node={ni.node_name}: device={bool(got[j])} "
+                    f"oracle={want[ni.node_name]}"
+                )
+
+    def test_first_pod_escape(self):
+        # affinity to its own label on an empty cluster: schedulable
+        # (filtering.go:494)
+        nodes = [make_node("a").labels(zone="z1").obj()]
+        pod = (
+            make_pod("self")
+            .labels(app="web")
+            .pod_affinity("zone", {"app": "web"})
+            .obj()
+        )
+        snap = new_snapshot([], nodes)
+        nt = NodeTensorCache().update(snap)
+        af = pack_affinity_batch([pod], snap, nt)
+        got = _device_feasible(af, 0, nt.capacity)
+        assert bool(got[0])
+
+    def test_no_escape_for_non_self_matching_pod(self):
+        nodes = [make_node("a").labels(zone="z1").obj()]
+        pod = (
+            make_pod("lonely")
+            .labels(app="web")
+            .pod_affinity("zone", {"app": "db"})
+            .obj()
+        )
+        snap = new_snapshot([], nodes)
+        nt = NodeTensorCache().update(snap)
+        af = pack_affinity_batch([pod], snap, nt)
+        got = _device_feasible(af, 0, nt.capacity)
+        assert not bool(got[0])
+
+
+def _wait_all_decided(client, sched, count, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        pods, _ = client.list_pods()
+        if len(pods) >= count and all(
+            p.spec.node_name or p.status.conditions for p in pods
+        ):
+            sched.wait_for_inflight_binds()
+            return client.list_pods()[0]
+        time.sleep(0.05)
+    raise AssertionError("pods not decided in time")
+
+
+class TestEndToEndDeviceAffinity:
+    def _cluster(self, max_batch=32):
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(
+            client, informers, batch=True, max_batch=max_batch
+        )
+        return server, client, informers, sched
+
+    def test_anti_affinity_spreads_within_batch_on_device(self):
+        server, client, informers, sched = self._cluster()
+        for name, zone in (("a", "z1"), ("b", "z2"), ("c", "z3")):
+            client.create_node(
+                make_node(name).labels(zone=zone)
+                .capacity(cpu="8", memory="16Gi").obj()
+            )
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        # three self-anti-affinity pods -> one per zone; a fourth is
+        # unschedulable
+        for i in range(4):
+            client.create_pod(
+                make_pod(f"p{i}")
+                .labels(app="db")
+                .creation_timestamp(float(i))
+                .container(cpu="100m", memory="128Mi")
+                .pod_affinity("zone", {"app": "db"}, anti=True)
+                .obj()
+            )
+        sched.start()
+        pods = _wait_all_decided(client, sched, 4)
+        sched.stop()
+        informers.stop()
+        bound_zones = sorted(
+            {"a": "z1", "b": "z2", "c": "z3"}[p.spec.node_name]
+            for p in pods
+            if p.spec.node_name
+        )
+        assert bound_zones == ["z1", "z2", "z3"]
+        unbound = [p for p in pods if not p.spec.node_name]
+        assert len(unbound) == 1
+        assert sched.pods_fallback == 0
+        assert sched.pods_solved_on_device >= 4
+
+    def test_affinity_follows_within_batch_on_device(self):
+        server, client, informers, sched = self._cluster()
+        for name, zone in (("a", "z1"), ("b", "z2")):
+            client.create_node(
+                make_node(name).labels(zone=zone)
+                .capacity(cpu="8", memory="16Gi").obj()
+            )
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        # high-priority db pod lands somewhere; follower requires affinity
+        # to it and must land in the same zone
+        client.create_pod(
+            make_pod("leader").labels(app="db").priority(10)
+            .creation_timestamp(0.0)
+            .container(cpu="100m", memory="128Mi").obj()
+        )
+        client.create_pod(
+            make_pod("follower").labels(app="web")
+            .creation_timestamp(1.0)
+            .container(cpu="100m", memory="128Mi")
+            .pod_affinity("zone", {"app": "db"})
+            .obj()
+        )
+        sched.start()
+        pods = _wait_all_decided(client, sched, 2)
+        sched.stop()
+        informers.stop()
+        by_name = {p.metadata.name: p for p in pods}
+        assert by_name["leader"].spec.node_name
+        assert (
+            by_name["follower"].spec.node_name
+            == by_name["leader"].spec.node_name
+        )
+        assert sched.pods_fallback == 0
+
+    def test_existing_anti_affinity_no_longer_disables_batching(self):
+        server, client, informers, sched = self._cluster()
+        for name, zone in (("a", "z1"), ("b", "z2")):
+            client.create_node(
+                make_node(name).labels(zone=zone)
+                .capacity(cpu="8", memory="16Gi").obj()
+            )
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        # a guard pod with required anti-affinity already runs on node a
+        client.create_pod(
+            make_pod("guard").node("a").labels(app="db")
+            .container(cpu="100m", memory="128Mi")
+            .pod_affinity("zone", {"app": "db"}, anti=True)
+            .obj()
+        )
+        informers.pump()
+        # a plain batch pod matching the guard's selector must avoid z1;
+        # unrelated pods still batch on device
+        for i in range(6):
+            client.create_pod(
+                make_pod(f"w{i}").labels(app="web")
+                .container(cpu="100m", memory="128Mi").obj()
+            )
+        client.create_pod(
+            make_pod("rival").labels(app="db")
+            .container(cpu="100m", memory="128Mi").obj()
+        )
+        sched.start()
+        pods = _wait_all_decided(client, sched, 8)
+        sched.stop()
+        informers.stop()
+        by_name = {p.metadata.name: p for p in pods}
+        assert by_name["rival"].spec.node_name == "b"
+        assert all(
+            by_name[f"w{i}"].spec.node_name for i in range(6)
+        )
+        assert sched.pods_fallback == 0
+        assert sched.pods_solved_on_device >= 7

@@ -297,9 +297,17 @@ class TestSolverSupported:
     def test_plain_pod(self):
         assert solver_supported(make_pod("p").container(cpu="1").obj())
 
-    def test_affinity_not_supported(self):
-        assert not solver_supported(
+    def test_required_affinity_supported_on_device(self):
+        assert solver_supported(
             make_pod("p").pod_affinity("zone", {"a": "b"}).obj()
+        )
+        assert solver_supported(
+            make_pod("p").pod_affinity("zone", {"a": "b"}, anti=True).obj()
+        )
+
+    def test_preferred_affinity_not_supported(self):
+        assert not solver_supported(
+            make_pod("p").preferred_pod_affinity("zone", {"a": "b"}).obj()
         )
 
     def test_hard_spread_supported_on_device(self):
