@@ -126,6 +126,11 @@ class Framework:
     def has_filter_plugins(self) -> bool:
         return bool(self._by_point["filter"])
 
+    def score_plugin_weights(self) -> Dict[str, int]:
+        """Enabled score plugin -> weight (the batch solver mirrors these
+        on device, ops/scoring.py)."""
+        return dict(self._score_weights)
+
     def uses_default_binder_only(self) -> bool:
         """True when the bind chain is exactly [DefaultBinder]: the batch
         committer may then coalesce the whole batch into one bulk binding

@@ -68,13 +68,13 @@ def _fits(free: jnp.ndarray, pod_req: jnp.ndarray) -> jnp.ndarray:
 
 @dataclass(frozen=True)
 class GreedyConfig:
-    """Device score-plugin weights: the resource scorers only
-    (LeastAllocated/BalancedAllocation at the default provider's weight 1,
-    MostAllocated for bin-packing profiles). Label-dependent soft scorers
-    (ImageLocality, preferred NodeAffinity, TaintToleration
-    PreferNoSchedule, ...) are not yet on device, so batch-path rankings
-    can differ from the sequential path by those terms; hard constraints
-    are protected by the static mask + cluster_solver_compatible gate."""
+    """Device resource-scorer weights (LeastAllocated/BalancedAllocation
+    at the default provider's weight 1, MostAllocated for bin-packing
+    profiles). The label-dependent scorers (ImageLocality, preferred
+    NodeAffinity, TaintToleration PreferNoSchedule, SelectorSpread, soft
+    spread, NodePreferAvoidPods) ride the ``scoring`` tensors of
+    greedy_assign_constrained (ops/scoring.py) with the profile's own
+    weights."""
 
     least_allocated_weight: int = 1
     balanced_allocation_weight: int = 1
@@ -383,13 +383,15 @@ def greedy_assign_constrained(
     active: jnp.ndarray,  # [B] bool
     spread: Tuple[jnp.ndarray, ...],
     affinity: Tuple[jnp.ndarray, ...],
+    scoring: Tuple[jnp.ndarray, ...],
     config: GreedyConfig = GreedyConfig(),
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """The full constrained assignment scan: NodeResourcesFit + static
     label mask + hard topology spread (ops/topology.py) + required pod
-    (anti-)affinity (ops/affinity.py), with every constraint family's
-    count tensors replayed in the scan carry so within-batch interactions
-    match the sequential addNominatedPods semantics
+    (anti-)affinity (ops/affinity.py) + the full default score plugin set
+    (ops/scoring.py), with every constraint family's count tensors
+    replayed in the scan carry so within-batch interactions match the
+    sequential addNominatedPods semantics
     (interpodaffinity/filtering.go:75 updateWithPod,
     podtopologyspread/filtering.go:127 updateWithPod).
 
@@ -399,6 +401,13 @@ def greedy_assign_constrained(
 
     ``affinity``: the AffinityBatch arrays (ops/affinity.py docstring) --
     zero counts + all -1 rows make it a no-op.
+
+    ``scoring``: the ScoreBatch arrays (ops/scoring.py docstring) --
+    zero rows/weights make it a no-op. Normalizations (max-scale for
+    preferred NodeAffinity, reversed for TaintToleration, zone-blended
+    inversion for SelectorSpread, flipped-linear for soft spread) run
+    per step over THAT step's feasible set, matching the reference's
+    normalize-over-filtered-nodes semantics.
     """
     (sp_counts0, sp_value_valid, sp_node_value,
      sp_pod_groups, sp_pod_max_skew, sp_pod_self, sp_pod_match) = spread
@@ -407,6 +416,15 @@ def greedy_assign_constrained(
      af_counts_anti0, af_row_key_anti, af_pod_anti_rows, af_pod_bump_anti,
      af_counts_exist0, af_row_key_exist, af_pod_exist_match,
      af_pod_bump_exist) = affinity
+    (sc_direct, sc_nodeaff, sc_taint, sc_pod_sig,
+     sc_sel_counts0, sc_zone_onehot, sc_zone_id, sc_pod_sel_group,
+     sc_pod_sel_match, sc_soft_counts0, sc_soft_node_value,
+     sc_pod_soft_groups, sc_pod_soft_match, sc_weights) = scoring
+    w_na, w_tt, w_sel, w_soft = (
+        sc_weights[0], sc_weights[1], sc_weights[2], sc_weights[3]
+    )
+    big_soft = jnp.int32(1 << 20)
+    soft_iota = jnp.arange(sc_soft_counts0.shape[0], dtype=jnp.int32)
 
     static_mask = mask_rows[mask_index]
     caps = allocatable[:, :2]
@@ -428,11 +446,13 @@ def greedy_assign_constrained(
 
     def step(carry, inputs):
         (req_state, nzr_state, sp_counts,
-         counts_aff, counts_anti, counts_exist) = carry
+         counts_aff, counts_anti, counts_exist,
+         sel_counts, soft_counts) = carry
         (pod_req, p_nzr, smask, is_active,
          groups, skews, selfs, match,
          aff_rows, self_match, bump_aff,
-         anti_rows, bump_anti, exist_match, bump_exist) = inputs
+         anti_rows, bump_anti, exist_match, bump_exist,
+         sig, sel_group, sel_match, soft_groups, soft_match) = inputs
 
         free = allocatable - req_state
         fits = _fits(free, pod_req)
@@ -476,6 +496,90 @@ def greedy_assign_constrained(
                 caps, nzr_state, p_nzr[None, :]
             )[0]
 
+        # -- non-resource score plugins (ops/scoring.py) --------------------
+        # static direct rows (ImageLocality + NodePreferAvoidPods,
+        # pre-weighted, no normalize)
+        score = score + sc_direct[sig]
+        # preferred NodeAffinity: max-scale normalize over the feasible set
+        na_raw = sc_nodeaff[sig]
+        na_max = jnp.max(jnp.where(feasible, na_raw, 0))
+        score = score + jnp.where(
+            na_max > 0,
+            w_na * jnp.floor(
+                100.0 * na_raw / jnp.maximum(na_max, 1).astype(jnp.float32)
+            ),
+            0.0,
+        )
+        # TaintToleration: reversed normalize (fewer intolerable
+        # PreferNoSchedule taints => higher; max 0 => all 100)
+        tt_raw = sc_taint[sig]
+        tt_max = jnp.max(jnp.where(feasible, tt_raw, 0))
+        tt_scaled = jnp.floor(
+            100.0 * tt_raw / jnp.maximum(tt_max, 1).astype(jnp.float32)
+        )
+        score = score + w_tt * jnp.where(tt_max > 0, 100.0 - tt_scaled, 100.0)
+        # SelectorSpread: inverted counts, zone-blended 2/3
+        # (default_pod_topology_spread.go:107)
+        sel_raw = sel_counts[jnp.maximum(sel_group, 0)]
+        sel_feas = jnp.where(feasible, sel_raw, 0)
+        sel_max_node = jnp.max(sel_feas)
+        zsum = sel_feas @ sc_zone_onehot.astype(jnp.int32)  # [Z]
+        have_zones = (feasible & (sc_zone_id >= 0)).any()
+        sel_max_zone = jnp.max(zsum)
+        f_node = jnp.where(
+            sel_max_node > 0,
+            100.0 * (sel_max_node - sel_raw)
+            / jnp.maximum(sel_max_node, 1).astype(jnp.float32),
+            100.0,
+        )
+        zs_n = zsum[jnp.clip(sc_zone_id, 0)]
+        f_zone = jnp.where(
+            sel_max_zone > 0,
+            100.0 * (sel_max_zone - zs_n)
+            / jnp.maximum(sel_max_zone, 1).astype(jnp.float32),
+            100.0,
+        )
+        blended = jnp.where(
+            have_zones & (sc_zone_id >= 0),
+            f_node / 3.0 + (2.0 / 3.0) * f_zone,
+            f_node,
+        )
+        score = score + jnp.where(
+            sel_group >= 0, w_sel * jnp.floor(blended), 0.0
+        )
+        # soft topology spread: flipped-linear against (total - min) over
+        # feasible eligible nodes (podtopologyspread/scoring.go:199)
+        sg_safe = jnp.clip(soft_groups, 0)
+        soft_nv = sc_soft_node_value[sg_safe]  # [C, N]
+        soft_cnt = jnp.take_along_axis(
+            soft_counts[sg_safe],
+            jnp.clip(soft_nv, 0, soft_counts.shape[1] - 1),
+            axis=1,
+        )  # [C, N]
+        rows_live = (soft_groups >= 0)[:, None]
+        soft_raw = jnp.where(rows_live & (soft_nv >= 0), soft_cnt, 0).sum(0)
+        soft_eligible = jnp.where(rows_live, soft_nv >= 0, True).all(0)
+        has_soft = (soft_groups >= 0).any()
+        dom = feasible & soft_eligible
+        soft_total = jnp.sum(jnp.where(dom, soft_raw, 0))
+        soft_min = jnp.where(
+            dom.any(), jnp.min(jnp.where(dom, soft_raw, big_soft)), big_soft
+        )
+        soft_diff = (soft_total - soft_min).astype(jnp.float32)
+        soft_score = jnp.where(
+            soft_diff == 0,
+            100.0,
+            jnp.where(
+                ~soft_eligible,
+                0.0,
+                jnp.floor(
+                    100.0 * (soft_total - soft_raw)
+                    / jnp.where(soft_diff == 0, 1.0, soft_diff)
+                ),
+            ),
+        )
+        score = score + jnp.where(has_soft, w_soft * soft_score, 0.0)
+
         score = jnp.where(feasible, score, -jnp.inf)
         choice = jnp.argmax(score).astype(jnp.int32)
         placed = feasible.any() & is_active
@@ -494,6 +598,14 @@ def greedy_assign_constrained(
             group_iota, jnp.clip(vals_at_choice, 0, sp_counts.shape[1] - 1)
         ].add(sp_bump)
 
+        # score-family count replay
+        placed_i32 = placed.astype(jnp.int32)
+        sel_counts = sel_counts.at[:, choice].add(sel_match * placed_i32)
+        soft_vc = sc_soft_node_value[:, choice]  # [Gt]
+        soft_counts = soft_counts.at[
+            soft_iota, jnp.clip(soft_vc, 0, soft_counts.shape[1] - 1)
+        ].add(soft_match * (soft_vc >= 0) * placed_i32)
+
         # affinity count replay (updateWithPod :75 generalized)
         placed_i = placed.astype(jnp.int32)
         va = vals_aff[:, choice]
@@ -510,19 +622,55 @@ def greedy_assign_constrained(
         )
 
         carry = (req_state, nzr_state, sp_counts,
-                 counts_aff, counts_anti, counts_exist)
+                 counts_aff, counts_anti, counts_exist,
+                 sel_counts, soft_counts)
         return carry, assignment
 
     carry0 = (requested, nzr, sp_counts0,
-              af_counts_aff0, af_counts_anti0, af_counts_exist0)
+              af_counts_aff0, af_counts_anti0, af_counts_exist0,
+              sc_sel_counts0, sc_soft_counts0)
     xs = (
         pod_requests, pod_nzr, static_mask, active,
         sp_pod_groups, sp_pod_max_skew, sp_pod_self, sp_pod_match,
         af_pod_aff_rows, af_pod_self_match, af_pod_bump_aff,
         af_pod_anti_rows, af_pod_bump_anti, af_pod_exist_match,
         af_pod_bump_exist,
+        sc_pod_sig, sc_pod_sel_group, sc_pod_sel_match,
+        sc_pod_soft_groups, sc_pod_soft_match,
     )
-    (req_out, nzr_out, _, _, _, _), assignments = jax.lax.scan(
+    (req_out, nzr_out, _, _, _, _, _, _), assignments = jax.lax.scan(
         step, carry0, xs
     )
     return assignments, req_out, nzr_out
+def make_sharded_solver(mesh: "jax.sharding.Mesh", config: GreedyConfig = GreedyConfig()):
+    """Build a node-axis-sharded greedy solver for a device mesh.
+
+    Sharding layout (SURVEY.md section 2.5: data parallelism over the node
+    axis, the TPU analogue of ParallelizeUntil's 16 goroutines): every
+    ``[N, ...]`` operand is split over the ``nodes`` mesh axis, pod-batch
+    operands are replicated, and XLA inserts the ICI collectives for the
+    cross-shard argmax inside the scan. N must be a multiple of the mesh
+    size (NodeTensorCache pads to 128 rows).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    node = NamedSharding(mesh, P("nodes"))
+    node2d = NamedSharding(mesh, P("nodes", None))
+    batch_by_node = NamedSharding(mesh, P(None, "nodes"))
+    repl = NamedSharding(mesh, P())
+
+    def solve(allocatable, requested, nzr, valid, pod_requests, pod_nzr,
+              static_mask, active):
+        return greedy_assign(
+            allocatable, requested, nzr, valid,
+            pod_requests, pod_nzr, static_mask, active, config=config,
+        )
+
+    return jax.jit(
+        solve,
+        in_shardings=(
+            node2d, node2d, node2d, node,  # node-axis state
+            repl, repl, batch_by_node, repl,  # pod batch
+        ),
+        out_shardings=(repl, node2d, node2d),
+    )

@@ -48,6 +48,13 @@ from kubernetes_tpu.ops.affinity import (
     pod_has_preferred_affinity,
 )
 from kubernetes_tpu.ops.host_masks import static_mask_compact
+from kubernetes_tpu.ops.scoring import (
+    ScoreEnvelopeExceeded,
+    batch_score_dynamic,
+    noop_score_tensors,
+    pack_score_batch,
+    pad_score_tensors,
+)
 from kubernetes_tpu.ops.topology import (
     noop_spread_tensors,
     pack_spread_batch,
@@ -68,13 +75,11 @@ def solver_supported(pod: Pod) -> bool:
     """Constraints the device solver models today. Anything else falls
     back to the sequential path (still fully correct, just not batched)."""
     spec = pod.spec
-    for c in spec.topology_spread_constraints:
-        # hard constraints are solved on device via the group-count scan
-        # (ops/topology.py); soft ones shape scoring, which the device
-        # scorer set doesn't include yet; combining spread with node
-        # selectors changes pair-count eligibility per pod
-        if c.when_unsatisfiable != "DoNotSchedule":
-            return False
+    # hard spread solves on device via the group-count scan
+    # (ops/topology.py), soft spread via the scoring tensors
+    # (ops/scoring.py); combining spread with node selectors changes
+    # pair-count eligibility per pod, which shared group counts can't
+    # express -- those pods take the host path
     if spec.topology_spread_constraints and (
         spec.node_selector
         or (
@@ -98,28 +103,6 @@ def solver_supported(pod: Pod) -> bool:
         if (
             v.pvc_claim_name or v.gce_pd_name or v.aws_ebs_volume_id
             or v.iscsi_target or v.rbd_image
-        ):
-            return False
-    return True
-
-
-_AVOID_PODS_ANNOTATION = "scheduler.alpha.kubernetes.io/preferAvoidPods"
-
-
-def cluster_solver_compatible(snapshot) -> bool:
-    """Cluster-level conditions the device solver can't express yet.
-
-    Existing pods' required anti-affinity is now modeled on device (the
-    exist-row tensors, ops/affinity.py), so the only remaining gate is the
-    preferAvoidPods annotation: it scores at weight 10000 -- a near-hard
-    exclusion sequentially -- which the device scorer set doesn't include.
-    Preferred-only (anti-)affinity on existing pods is a score divergence,
-    not a correctness one, and does not disable batching.
-    """
-    for ni in snapshot.list_node_infos():
-        if (
-            ni.node is not None
-            and _AVOID_PODS_ANNOTATION in ni.node.metadata.annotations
         ):
             return False
     return True
@@ -229,6 +212,14 @@ class BatchScheduler(Scheduler):
             if solver_supported(pi.pod) and not any(
                 e.is_interested(pi.pod) for e in extenders
             ):
+                # one profile per solver batch: score weights and owner
+                # lookups are profile-scoped (the sequential path resolves
+                # them per pod, scheduler.go:741)
+                if solver_infos and (
+                    solver_infos[0].pod.spec.scheduler_name
+                    != pi.pod.spec.scheduler_name
+                ):
+                    flush()
                 solver_infos.append(pi)
             else:
                 flush()
@@ -282,12 +273,21 @@ class BatchScheduler(Scheduler):
         in-flight batch would change (spread counts, nominee overlays,
         incompatible clusters) drain the pipeline first."""
         pods = [pi.pod for pi in solver_infos]
-        has_spread = any(p.spec.topology_spread_constraints for p in pods)
+        has_hard_spread = any(
+            c.when_unsatisfiable == "DoNotSchedule"
+            for p in pods
+            for c in p.spec.topology_spread_constraints
+        )
         has_affinity = batch_has_affinity(pods)
         has_required_anti = batch_has_required_anti_affinity(pods)
+        prof0 = self.profiles.get(pods[0].spec.scheduler_name)
+        score_dynamic = batch_score_dynamic(
+            pods, prof0.informers if prof0 is not None else None
+        )
         nominated_by_node = self.queue.all_nominated_pods_by_node()
         if self._pending is not None and (
-            has_spread or has_affinity or nominated_by_node
+            has_hard_spread or has_affinity or score_dynamic
+            or nominated_by_node
             # an in-flight batch carrying required anti-affinity imposes
             # symmetric constraints this batch can only see once its
             # placements are committed to the host cache
@@ -311,14 +311,6 @@ class BatchScheduler(Scheduler):
                 self._drain_pending()
                 self.cache.update_snapshot(snapshot)
                 nominated_by_node = self.queue.all_nominated_pods_by_node()
-        if not cluster_solver_compatible(snapshot):
-            # a fallback pod placed earlier in this batch (or informer
-            # churn) introduced constraints the device can't model yet
-            self._drain_pending()
-            for pi in solver_infos:
-                self.pods_fallback += 1
-                self.attempt_schedule(pi)
-            return None
         nt = self.tensor_cache.update(snapshot)
         batch = pack_pod_batch(
             pods, nt.dims, timestamps=[pi.timestamp for pi in solver_infos]
@@ -378,11 +370,28 @@ class BatchScheduler(Scheduler):
         # hard topology-spread constraints solve on device via the
         # group-count scan (ops/topology.py); required (anti-)affinity via
         # the count-tensor replay (ops/affinity.py)
+        # non-resource score plugins: pack when they can influence ranking
+        # (dynamic families already forced a pipeline drain above, so the
+        # snapshot these counts come from includes in-flight placements)
+        ordered_pods = [pods[int(i)] for i in order]
+        try:
+            score_batch = pack_score_batch(
+                ordered_pods, snapshot, nt,
+                prof0.informers if prof0 is not None else None,
+                prof0.score_plugin_weights() if prof0 is not None else {},
+            )
+        except ScoreEnvelopeExceeded:
+            # the sequential path filters against the host cache, which
+            # must include every in-flight placement
+            self._drain_pending()
+            for pi in solver_infos:
+                self.pods_fallback += 1
+                self.attempt_schedule(pi)
+            return None
+
         spread = None
         affinity = None
-        if has_spread or has_affinity:
-            ordered_pods = [pods[int(i)] for i in order]
-        if has_spread:
+        if has_hard_spread:
             spread = pack_spread_batch(ordered_pods, snapshot, nt)
             if spread is None:
                 # envelope exceeded: host path keeps full correctness
@@ -457,7 +466,7 @@ class BatchScheduler(Scheduler):
             ds.alloc_dev, req_state_d, nzr_state_d, ds.valid_dev,
             req_d, nzr_d, rows_d, midx_d, active_d,
         )
-        if spread is None and affinity is None:
+        if spread is None and affinity is None and score_batch is None:
             assignments_dev, req_out, nzr_out = greedy_assign_compact(
                 *common_args, config=self.solver_config
             )
@@ -471,11 +480,17 @@ class BatchScheduler(Scheduler):
                 af_tensors = pad_affinity_tensors(affinity, padded)
             else:
                 af_tensors = noop_affinity_tensors(padded, nt.capacity)
+            if score_batch is not None:
+                sc_tensors = pad_score_tensors(score_batch, padded)
+            else:
+                sc_tensors = noop_score_tensors(padded, nt.capacity)
             # common_args carries (mask_rows, mask_index) in compact form;
             # the constrained kernel takes the same layout
-            sp_dev, af_dev = jax.device_put((sp_tensors, af_tensors))
+            sp_dev, af_dev, sc_dev = jax.device_put(
+                (sp_tensors, af_tensors, sc_tensors)
+            )
             assignments_dev, req_out, nzr_out = greedy_assign_constrained(
-                *common_args, tuple(sp_dev), tuple(af_dev),
+                *common_args, tuple(sp_dev), tuple(af_dev), tuple(sc_dev),
                 config=self.solver_config,
             )
         # start the result transfer now so it overlaps host commit work
@@ -686,14 +701,16 @@ class BatchScheduler(Scheduler):
         common = (alloc, req_state, nzr_state, valid, req, nzr, rows, midx, active)
         out = greedy_assign_compact(*common, config=self.solver_config)
         jax.block_until_ready(out)
-        sp_dev, af_dev = jax.device_put(
+        sp_dev, af_dev, sc_dev = jax.device_put(
             (
                 noop_spread_tensors(padded, n),
                 noop_affinity_tensors(padded, n),
+                noop_score_tensors(padded, n),
             )
         )
         out = greedy_assign_constrained(
-            *common, tuple(sp_dev), tuple(af_dev), config=self.solver_config
+            *common, tuple(sp_dev), tuple(af_dev), tuple(sc_dev),
+            config=self.solver_config,
         )
         jax.block_until_ready(out)
 

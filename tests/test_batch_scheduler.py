@@ -100,15 +100,11 @@ class TestBatchScheduling:
         informers.start()
         informers.wait_for_cache_sync()
         sched.queue.run()
-        # SOFT spread constraints shape scoring -> not solver_supported
+        # host-port pods can't solve on device -> sequential fallback
         for i in range(4):
             client.create_pod(
                 make_pod(f"s{i}").labels(app="s")
-                .container(cpu="100m")
-                .spread_constraint(
-                    1, "zone", when_unsatisfiable="ScheduleAnyway",
-                    match_labels={"app": "s"},
-                )
+                .container(cpu="100m", host_port=8080 + i)
                 .obj()
             )
         for i in range(4):
@@ -315,8 +311,8 @@ class TestSolverSupported:
             make_pod("p").spread_constraint(1, "zone").obj()
         )
 
-    def test_soft_spread_not_supported(self):
-        assert not solver_supported(
+    def test_soft_spread_supported_on_device(self):
+        assert solver_supported(
             make_pod("p").spread_constraint(
                 1, "zone", when_unsatisfiable="ScheduleAnyway"
             ).obj()
