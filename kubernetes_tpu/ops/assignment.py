@@ -674,3 +674,81 @@ def make_sharded_solver(mesh: "jax.sharding.Mesh", config: GreedyConfig = Greedy
         ),
         out_shardings=(repl, node2d, node2d),
     )
+
+
+@partial(jax.jit, static_argnames=("config", "iters"))
+def sinkhorn_assign(
+    allocatable: jnp.ndarray,  # [N, R] int32
+    requested: jnp.ndarray,  # [N, R] int32
+    nzr: jnp.ndarray,  # [N, 2] int32
+    valid: jnp.ndarray,  # [N] bool
+    pod_requests: jnp.ndarray,  # [B, R] int32, solve order
+    pod_nzr: jnp.ndarray,  # [B, 2] int32
+    mask_rows: jnp.ndarray,  # [U, N] deduplicated static-mask rows
+    mask_index: jnp.ndarray,  # [B] int32
+    active: jnp.ndarray,  # [B] bool
+    config: GreedyConfig = GreedyConfig(),
+    iters: int = 50,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Globally-aware assignment for the churn/rebalance regime
+    (BASELINE config #5): an entropic-OT transport plan over the whole
+    batch (ops/sinkhorn.py) replaces the myopic per-step ranking, then the
+    EXACT capacity-replay commit scan enforces feasibility step by step.
+    Same signature family as greedy_assign_compact so the BatchScheduler
+    can select it per profile (solver_mode="sinkhorn").
+
+    Under a node-sharded mesh the row/column normalizations inside
+    sinkhorn_plan become psum-style ICI collectives inserted by XLA
+    (SURVEY.md section 2.5)."""
+    from kubernetes_tpu.ops.sinkhorn import refine_scores
+
+    sm = mask_rows[mask_index]  # [B, N]
+    caps = allocatable[:, :2]
+
+    # batch-start scores + feasibility feed the global plan; the commit
+    # scan below re-checks fit exactly per step
+    base = jnp.zeros(sm.shape, dtype=jnp.float32)
+    if config.least_allocated_weight:
+        base += config.least_allocated_weight * least_allocated_score(
+            caps, nzr, pod_nzr
+        )
+    if config.balanced_allocation_weight:
+        base += config.balanced_allocation_weight * balanced_allocation_score(
+            caps, nzr, pod_nzr
+        )
+    if config.most_allocated_weight:
+        base += config.most_allocated_weight * most_allocated_score(
+            caps, nzr, pod_nzr
+        )
+    free = allocatable - requested
+    feasible0 = jax.vmap(lambda pr: _fits(free, pr))(pod_requests)
+    feasible0 = feasible0 & sm & valid[None, :]
+    slots = jnp.maximum(
+        (allocatable[:, _PODS_COL] - requested[:, _PODS_COL]).astype(
+            jnp.float32
+        ),
+        0.0,
+    )
+    refined = refine_scores(base, feasible0, slots, active, iters=iters)
+
+    n = allocatable.shape[0]
+    node_iota = jnp.arange(n, dtype=jnp.int32)
+
+    def step(carry, inputs):
+        req_state, nzr_state = carry
+        pod_req, p_nzr, smask, is_active, row = inputs
+        fits = _fits(allocatable - req_state, pod_req)
+        feasible = fits & smask & valid
+        score = jnp.where(feasible, row, -jnp.inf)
+        choice = jnp.argmax(score).astype(jnp.int32)
+        placed = feasible.any() & is_active
+        assignment = jnp.where(placed, choice, NO_NODE)
+        chosen = (node_iota == choice) & placed
+        req_state = req_state + chosen[:, None] * pod_req[None, :]
+        nzr_state = nzr_state + chosen[:, None] * p_nzr[None, :]
+        return (req_state, nzr_state), assignment
+
+    (req_out, nzr_out), assignments = jax.lax.scan(
+        step, (requested, nzr), (pod_requests, pod_nzr, sm, active, refined)
+    )
+    return assignments, req_out, nzr_out

@@ -37,6 +37,7 @@ from kubernetes_tpu.ops.assignment import (
     NO_NODE,
     greedy_assign_compact,
     greedy_assign_constrained,
+    sinkhorn_assign,
 )
 from kubernetes_tpu.ops.affinity import (
     batch_has_affinity,
@@ -149,13 +150,36 @@ class BatchScheduler(Scheduler):
         solver_config: GreedyConfig = GreedyConfig(),
         tensor_cache: Optional[NodeTensorCache] = None,
         batch_window: float = 0.01,
+        solver_mode: str = "greedy",
+        mesh=None,
         **kwargs,
     ) -> None:
+        """``solver_mode``: "greedy" replays the sequential argmax exactly
+        (parity mode); "sinkhorn" adds the entropic-OT global prior for
+        the churn/rebalance regime (ops/sinkhorn.py) on unconstrained
+        batches -- constrained batches always use the greedy replay.
+
+        ``mesh``: an optional ``jax.sharding.Mesh`` with a "nodes" axis;
+        node-dimension tensors are device_put with node-axis shardings and
+        GSPMD partitions the solver scan across the mesh, inserting the
+        cross-shard argmax/psum collectives over ICI (SURVEY.md
+        section 2.5)."""
         super().__init__(*args, **kwargs)
         self.max_batch = max_batch
         self.solver_config = solver_config
         self.tensor_cache = tensor_cache or NodeTensorCache()
         self.batch_window = batch_window
+        if solver_mode not in ("greedy", "sinkhorn"):
+            raise ValueError(f"unknown solver_mode {solver_mode!r}")
+        self.solver_mode = solver_mode
+        self.mesh = mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._sh_node1 = NamedSharding(mesh, P("nodes"))
+            self._sh_node2 = NamedSharding(mesh, P("nodes", None))
+            self._sh_rows = NamedSharding(mesh, P(None, "nodes"))
+            self._sh_repl = NamedSharding(mesh, P())
         self.batches_solved = 0
         self.pods_solved_on_device = 0
         self.pods_fallback = 0
@@ -437,11 +461,24 @@ class BatchScheduler(Scheduler):
 
         # one batched host->device transfer for everything we must upload
         to_upload = [req, nzr, rows, midx, active]
+        shardings = None
+        if self.mesh is not None:
+            shardings = [
+                self._sh_repl, self._sh_repl, self._sh_rows,
+                self._sh_repl, self._sh_repl,
+            ]
         if not static_ok:
             to_upload += [nt.allocatable, nt.valid]
+            if shardings is not None:
+                shardings += [self._sh_node2, self._sh_node1]
         if not carry_ok:
             to_upload += [node_requested, node_nzr]
-        uploaded = jax.device_put(tuple(to_upload))
+            if shardings is not None:
+                shardings += [self._sh_node2, self._sh_node2]
+        if shardings is not None:
+            uploaded = jax.device_put(tuple(to_upload), tuple(shardings))
+        else:
+            uploaded = jax.device_put(tuple(to_upload))
         it = iter(uploaded)
         req_d, nzr_d, rows_d, midx_d, active_d = (
             next(it), next(it), next(it), next(it), next(it)
@@ -467,7 +504,12 @@ class BatchScheduler(Scheduler):
             req_d, nzr_d, rows_d, midx_d, active_d,
         )
         if spread is None and affinity is None and score_batch is None:
-            assignments_dev, req_out, nzr_out = greedy_assign_compact(
+            solver = (
+                sinkhorn_assign
+                if self.solver_mode == "sinkhorn"
+                else greedy_assign_compact
+            )
+            assignments_dev, req_out, nzr_out = solver(
                 *common_args, config=self.solver_config
             )
         else:
@@ -486,9 +528,15 @@ class BatchScheduler(Scheduler):
                 sc_tensors = noop_score_tensors(padded, nt.capacity)
             # common_args carries (mask_rows, mask_index) in compact form;
             # the constrained kernel takes the same layout
-            sp_dev, af_dev, sc_dev = jax.device_put(
-                (sp_tensors, af_tensors, sc_tensors)
-            )
+            if self.mesh is not None:
+                # constraint tensors are small: replicate on the mesh
+                sp_dev, af_dev, sc_dev = jax.device_put(
+                    (sp_tensors, af_tensors, sc_tensors), self._sh_repl
+                )
+            else:
+                sp_dev, af_dev, sc_dev = jax.device_put(
+                    (sp_tensors, af_tensors, sc_tensors)
+                )
             assignments_dev, req_out, nzr_out = greedy_assign_constrained(
                 *common_args, tuple(sp_dev), tuple(af_dev), tuple(sc_dev),
                 config=self.solver_config,
@@ -689,25 +737,39 @@ class BatchScheduler(Scheduler):
             return
         r = nt.dims.num_dims
         padded = self.max_batch
-        alloc = jnp.asarray(nt.allocatable)
-        req_state = jnp.asarray(nt.requested)
-        nzr_state = jnp.asarray(nt.non_zero_requested)
-        valid = jnp.asarray(nt.valid)
-        req = jnp.zeros((padded, r), dtype=jnp.int32)
-        nzr = jnp.zeros((padded, 2), dtype=jnp.int32)
-        rows = jnp.zeros((MASK_ROW_BUCKET, n), dtype=bool)
-        midx = jnp.zeros(padded, dtype=jnp.int32)
-        active = jnp.zeros(padded, dtype=bool)
-        common = (alloc, req_state, nzr_state, valid, req, nzr, rows, midx, active)
+        host = (
+            nt.allocatable, nt.requested, nt.non_zero_requested, nt.valid,
+            np.zeros((padded, r), dtype=np.int32),
+            np.zeros((padded, 2), dtype=np.int32),
+            np.zeros((MASK_ROW_BUCKET, n), dtype=bool),
+            np.zeros(padded, dtype=np.int32),
+            np.zeros(padded, dtype=bool),
+        )
+        if self.mesh is not None:
+            common = jax.device_put(
+                host,
+                (
+                    self._sh_node2, self._sh_node2, self._sh_node2,
+                    self._sh_node1, self._sh_repl, self._sh_repl,
+                    self._sh_rows, self._sh_repl, self._sh_repl,
+                ),
+            )
+        else:
+            common = jax.device_put(host)
+        if self.solver_mode == "sinkhorn":
+            out = sinkhorn_assign(*common, config=self.solver_config)
+            jax.block_until_ready(out)
         out = greedy_assign_compact(*common, config=self.solver_config)
         jax.block_until_ready(out)
-        sp_dev, af_dev, sc_dev = jax.device_put(
-            (
-                noop_spread_tensors(padded, n),
-                noop_affinity_tensors(padded, n),
-                noop_score_tensors(padded, n),
-            )
+        noops = (
+            noop_spread_tensors(padded, n),
+            noop_affinity_tensors(padded, n),
+            noop_score_tensors(padded, n),
         )
+        if self.mesh is not None:
+            sp_dev, af_dev, sc_dev = jax.device_put(noops, self._sh_repl)
+        else:
+            sp_dev, af_dev, sc_dev = jax.device_put(noops)
         out = greedy_assign_constrained(
             *common, tuple(sp_dev), tuple(af_dev), tuple(sc_dev),
             config=self.solver_config,

@@ -445,6 +445,8 @@ def new_scheduler(
     batch: bool = False,
     max_batch: int = 256,
     solver_config=None,
+    solver_mode: str = "greedy",
+    mesh=None,
     extenders: Optional[List] = None,
 ) -> Scheduler:
     """Build a fully wired scheduler (reference scheduler.go:223 New +
@@ -513,6 +515,8 @@ def new_scheduler(
             async_binding=async_binding,
             max_batch=max_batch,
             solver_config=solver_config or GreedyConfig(),
+            solver_mode=solver_mode,
+            mesh=mesh,
         )
     else:
         sched = Scheduler(
