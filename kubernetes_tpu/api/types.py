@@ -613,7 +613,16 @@ def pod_resource_requests(pod: Pod) -> ResourceList:
     Reference semantics (fit.go:99 computePodResourceRequest): sum of all
     app containers, element-wise max with each init container, plus
     pod overhead.
+
+    Memoized per pod object: the result is recomputed for every cache
+    add/remove and every tensor pack, and pod specs are immutable once
+    in the informer cache (updates arrive as new objects). Callers that
+    mutate ``spec.containers`` in place (test fixtures) must do so before
+    the pod first flows through the scheduler.
     """
+    memo = pod.__dict__.get("_req_memo")
+    if memo is not None:
+        return memo
     out: Dict[str, int] = {}
     for c in pod.spec.containers:
         for name, qty in c.resources.requests.items():
@@ -624,6 +633,7 @@ def pod_resource_requests(pod: Pod) -> ResourceList:
                 out[name] = qty
     for name, qty in pod.spec.overhead.items():
         out[name] = out.get(name, 0) + qty
+    pod.__dict__["_req_memo"] = out
     return out
 
 

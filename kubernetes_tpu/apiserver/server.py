@@ -19,8 +19,8 @@ mutating (the same contract client-go informer caches impose).
 
 from __future__ import annotations
 
-import queue as _queue
 import threading
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -47,40 +47,65 @@ class WatchEvent:
 
 
 class Watch:
-    """One client watch stream; events arrive on an internal queue."""
+    """One client watch stream.
+
+    Events land in a deque under a Condition; producers can deliver in
+    bulk (one lock round trip per transaction instead of per event) and
+    consumers can drain in bulk (``next_batch``) -- the in-proc analogue
+    of the reference's HTTP/2 watch stream frames carrying many events
+    per read.
+    """
 
     def __init__(self, server: "APIServer", kind: str):
         self._server = server
         self.kind = kind
-        self._q: "_queue.Queue[Optional[WatchEvent]]" = _queue.Queue()
+        self._items: "deque[WatchEvent]" = deque()
+        self._cond = threading.Condition()
         self.stopped = False
 
     def _deliver(self, event: WatchEvent) -> None:
-        self._q.put(event)
+        with self._cond:
+            self._items.append(event)
+            self._cond.notify()
+
+    def _deliver_many(self, events: List[WatchEvent]) -> None:
+        with self._cond:
+            self._items.extend(events)
+            self._cond.notify()
 
     def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
         """Next event, or None on stop/timeout."""
-        try:
-            ev = self._q.get(timeout=timeout)
-        except _queue.Empty:
-            return None
-        return ev
+        with self._cond:
+            if not self._items and not self.stopped:
+                self._cond.wait(timeout)
+            if not self._items:
+                return None
+            return self._items.popleft()
+
+    def next_batch(
+        self, timeout: Optional[float] = None
+    ) -> List[WatchEvent]:
+        """Block for at least one event (or stop/timeout), then drain
+        everything pending."""
+        with self._cond:
+            if not self._items and not self.stopped:
+                self._cond.wait(timeout)
+            out = list(self._items)
+            self._items.clear()
+            return out
 
     def pending(self) -> List[WatchEvent]:
         """Drain without blocking (used by the synchronous pump mode)."""
-        out = []
-        while True:
-            try:
-                ev = self._q.get_nowait()
-            except _queue.Empty:
-                return out
-            if ev is not None:
-                out.append(ev)
+        with self._cond:
+            out = list(self._items)
+            self._items.clear()
+            return out
 
     def stop(self) -> None:
-        self.stopped = True
         self._server._remove_watch(self)
-        self._q.put(None)
+        with self._cond:
+            self.stopped = True
+            self._cond.notify_all()
 
 
 def _obj_key(obj: Any) -> Tuple[str, str]:
@@ -129,6 +154,18 @@ class APIServer:
             del hist[: len(hist) // 2]
         for w in list(self._watches[kind]):
             w._deliver(event)
+
+    def _broadcast_many(self, kind: str, events: List[WatchEvent]) -> None:
+        """One history extend + one per-watch lock round trip for a whole
+        transaction's worth of events (the bulk-bind fan-out path)."""
+        if not events:
+            return
+        hist = self._history[kind]
+        hist.extend(events)
+        if len(hist) > self._history_limit:
+            del hist[: len(hist) // 2]
+        for w in list(self._watches[kind]):
+            w._deliver_many(events)
 
     def current_rv(self) -> int:
         with self._lock:
@@ -243,27 +280,41 @@ class APIServer:
 
     # -- pods/binding subresource (storage.go:159 BindingREST.Create) -------
 
+    def _bind_locked(self, binding: Binding) -> Pod:
+        """Validate + apply one binding; caller holds the store lock.
+        Returns the updated pod and appends nothing -- the caller decides
+        how to fan out the watch event (single vs bulk delivery)."""
+        import copy as _copy
+
+        old: Pod = self.get("Pod", binding.pod_namespace, binding.pod_name)
+        if binding.pod_uid and old.metadata.uid != binding.pod_uid:
+            raise Conflict(
+                f"pod {old.key()} uid mismatch: binding has "
+                f"{binding.pod_uid}, pod has {old.metadata.uid}"
+            )
+        if old.spec.node_name and old.spec.node_name != binding.target_node:
+            raise Conflict(
+                f"pod {old.key()} is already bound to {old.spec.node_name}"
+            )
+        if not binding.target_node:
+            raise ValueError("binding.target_node is required")
+        # copy-on-write update (guaranteed_update semantics)
+        pod = _copy.copy(old)
+        pod.metadata = _copy.copy(old.metadata)
+        pod.spec = _copy.copy(old.spec)
+        pod.status = _copy.copy(old.status)
+        pod.spec.node_name = binding.target_node
+        pod.metadata.resource_version = self._next_rv()
+        self._stores["Pod"][(binding.pod_namespace, binding.pod_name)] = pod
+        return pod
+
     def bind(self, binding: Binding) -> Pod:
         with self._lock:
-            pod: Pod = self.get("Pod", binding.pod_namespace, binding.pod_name)
-            if binding.pod_uid and pod.metadata.uid != binding.pod_uid:
-                raise Conflict(
-                    f"pod {pod.key()} uid mismatch: binding has "
-                    f"{binding.pod_uid}, pod has {pod.metadata.uid}"
-                )
-            if pod.spec.node_name and pod.spec.node_name != binding.target_node:
-                raise Conflict(
-                    f"pod {pod.key()} is already bound to {pod.spec.node_name}"
-                )
-            if not binding.target_node:
-                raise ValueError("binding.target_node is required")
-
-            def assign(p: Pod) -> None:
-                p.spec.node_name = binding.target_node
-
-            return self.guaranteed_update(
-                "Pod", binding.pod_namespace, binding.pod_name, assign
+            pod = self._bind_locked(binding)
+            self._broadcast(
+                "Pod", WatchEvent(MODIFIED, pod, pod.metadata.resource_version)
             )
+            return pod
 
     def bind_bulk(
         self, bindings: List[Binding]
@@ -272,14 +323,22 @@ class APIServer:
         ONE store transaction (the batch analogue of per-pod
         BindingREST.Create, storage.go:159). Per-binding failures don't
         abort the rest -- each slot returns (pod, None) or (None, error),
-        mirroring N independent API calls minus N-1 lock round trips."""
+        mirroring N independent API calls minus N-1 lock round trips.
+        Watch events for the whole transaction fan out in one bulk
+        delivery per watcher."""
         out: List[Tuple[Optional[Pod], Optional[Exception]]] = []
+        events: List[WatchEvent] = []
         with self._lock:
             for binding in bindings:
                 try:
-                    out.append((self.bind(binding), None))
+                    pod = self._bind_locked(binding)
+                    events.append(
+                        WatchEvent(MODIFIED, pod, pod.metadata.resource_version)
+                    )
+                    out.append((pod, None))
                 except Exception as e:  # noqa: BLE001 - per-slot result
                     out.append((None, e))
+            self._broadcast_many("Pod", events)
         return out
 
     # -- pod status subresource ---------------------------------------------

@@ -58,6 +58,26 @@ class SchedulerCache:
             self._pod_states[key] = _PodState(pod=pod, assumed=True)
             self._assumed_pods[key] = True
 
+    def assume_pods(self, pods: List[Pod]) -> List[Optional[Exception]]:
+        """Bulk assume under one lock hold (the batch-commit analogue of N
+        AssumePod calls). Per-pod failures don't abort the rest; slot i
+        carries pod i's error or None."""
+        out: List[Optional[Exception]] = []
+        with self._lock:
+            states = self._pod_states
+            for pod in pods:
+                key = pod.metadata.uid
+                if key in states:
+                    out.append(
+                        KeyError(f"pod {pod.key()} is already in the cache")
+                    )
+                    continue
+                self._add_pod_to_node(pod)
+                states[key] = _PodState(pod=pod, assumed=True)
+                self._assumed_pods[key] = True
+                out.append(None)
+        return out
+
     def finish_binding(self, pod: Pod) -> None:
         key = pod.metadata.uid
         with self._lock:
@@ -65,6 +85,15 @@ class SchedulerCache:
             if state and state.assumed:
                 state.binding_finished = True
                 state.deadline = self._now() + self._ttl
+
+    def finish_binding_bulk(self, pods: List[Pod]) -> None:
+        with self._lock:
+            deadline = self._now() + self._ttl
+            for pod in pods:
+                state = self._pod_states.get(pod.metadata.uid)
+                if state and state.assumed:
+                    state.binding_finished = True
+                    state.deadline = deadline
 
     def forget_pod(self, pod: Pod) -> None:
         key = pod.metadata.uid

@@ -89,7 +89,11 @@ def new_resource(rl: ResourceList) -> Resource:
 
 def non_zero_requests(pod: Pod) -> Tuple[int, int]:
     """(milliCPU, memory) with per-container defaults applied
-    (reference util/non_zero.go GetNonzeroRequests)."""
+    (reference util/non_zero.go GetNonzeroRequests). Memoized like
+    ``pod_resource_requests`` (same immutability contract)."""
+    memo = pod.__dict__.get("_nzr_memo")
+    if memo is not None:
+        return memo
     cpu = 0
     mem = 0
     for c in pod.spec.containers:
@@ -97,6 +101,7 @@ def non_zero_requests(pod: Pod) -> Tuple[int, int]:
         cmem = c.resources.requests.get(RESOURCE_MEMORY, 0)
         cpu += ccpu if ccpu else DEFAULT_MILLI_CPU_REQUEST
         mem += cmem if cmem else DEFAULT_MEMORY_REQUEST
+    pod.__dict__["_nzr_memo"] = (cpu, mem)
     return cpu, mem
 
 

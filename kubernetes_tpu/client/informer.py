@@ -109,34 +109,42 @@ class Informer:
         self.synced = True
 
     def _apply(self, ev: WatchEvent) -> None:
-        obj = ev.object
-        key = (obj.metadata.namespace, obj.metadata.name)
-        if ev.type == ADDED:
-            with self._lock:
-                self._store[key] = obj
-            for h in self._handlers:
-                h.handle(ADDED, None, obj)
-        elif ev.type == MODIFIED:
-            with self._lock:
-                old = self._store.get(key)
-                self._store[key] = obj
-            for h in self._handlers:
-                h.handle(MODIFIED, old, obj)
-        elif ev.type == DELETED:
-            with self._lock:
-                self._store.pop(key, None)
-            for h in self._handlers:
-                h.handle(DELETED, None, obj)
+        self._apply_batch([ev])
+
+    def _apply_batch(self, evs: List[WatchEvent]) -> None:
+        """Apply a frame of events: store updates under one lock hold,
+        handler dispatch outside it (handlers take their own locks --
+        cache, queue -- and must not nest inside the store lock)."""
+        if not evs:
+            return
+        dispatch = []
+        with self._lock:
+            store = self._store
+            for ev in evs:
+                obj = ev.object
+                key = (obj.metadata.namespace, obj.metadata.name)
+                if ev.type == ADDED:
+                    store[key] = obj
+                    dispatch.append((ADDED, None, obj))
+                elif ev.type == MODIFIED:
+                    old = store.get(key)
+                    store[key] = obj
+                    dispatch.append((MODIFIED, old, obj))
+                elif ev.type == DELETED:
+                    store.pop(key, None)
+                    dispatch.append((DELETED, None, obj))
+        handlers = self._handlers
+        for etype, old, obj in dispatch:
+            for h in handlers:
+                h.handle(etype, old, obj)
 
     def pump(self) -> int:
         """Synchronously process pending events; returns count."""
         if self._watch is None:
             self._list_and_start_watch()
-        n = 0
-        for ev in self._watch.pending():
-            self._apply(ev)
-            n += 1
-        return n
+        evs = self._watch.pending()
+        self._apply_batch(evs)
+        return len(evs)
 
     def start(self) -> None:
         if self._thread is not None:
@@ -146,9 +154,9 @@ class Informer:
 
         def run() -> None:
             while not self._stop.is_set():
-                ev = self._watch.next(timeout=0.1)
-                if ev is not None:
-                    self._apply(ev)
+                evs = self._watch.next_batch(timeout=0.1)
+                if evs:
+                    self._apply_batch(evs)
 
         self._thread = threading.Thread(
             target=run, name=f"informer-{self.kind}", daemon=True

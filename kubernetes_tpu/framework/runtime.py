@@ -104,6 +104,19 @@ class Framework:
         if len(self._by_point["queue_sort"]) > 1:
             raise ValueError("only one queue sort plugin can be enabled")
 
+        # per-point (plugin, relevance) pairs: a plugin may expose
+        # ``<point>_relevant(pod) -> bool`` declaring its hook a no-op for
+        # non-matching pods (Coscheduling without a group label,
+        # VolumeBinding without PVCs) -- the bulk commit path skips the
+        # whole extension point when nothing is relevant
+        self._relevance: Dict[str, List] = {
+            point: [
+                (pl, getattr(pl, point + "_relevant", None))
+                for pl in plist
+            ]
+            for point, plist in self._by_point.items()
+        }
+
     # -- handle surface (reference FrameworkHandle, interface.go:499) -------
 
     def snapshot_shared_lister(self):
@@ -125,6 +138,17 @@ class Framework:
 
     def has_filter_plugins(self) -> bool:
         return bool(self._by_point["filter"])
+
+    def has_plugins(self, point: str) -> bool:
+        return bool(self._by_point[point])
+
+    def plugins_relevant(self, point: str, pod: Pod) -> bool:
+        """True when at least one plugin at ``point`` may act on this pod
+        (no relevance predicate counts as always-relevant)."""
+        for pl, rel in self._relevance[point]:
+            if rel is None or rel(pod):
+                return True
+        return False
 
     def score_plugin_weights(self) -> Dict[str, int]:
         """Enabled score plugin -> weight (the batch solver mirrors these
@@ -155,6 +179,14 @@ class Framework:
         if not plugins:
             raise ValueError("no queue sort plugin enabled")
         return plugins[0].queue_sort_less
+
+    def queue_sort_key_func(self) -> Optional[Callable[[PodInfo], Any]]:
+        """Total-order sort key matching queue_sort_less, when the
+        QueueSort plugin provides one (the activeQ heap fast path)."""
+        plugins = self._by_point["queue_sort"]
+        if not plugins:
+            return None
+        return getattr(plugins[0], "queue_sort_key", None)
 
     # -- prefilter ----------------------------------------------------------
 

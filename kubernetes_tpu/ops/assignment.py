@@ -38,10 +38,11 @@ from kubernetes_tpu.ops.scores import (
     least_allocated_score,
     most_allocated_score,
 )
+from kubernetes_tpu.tensors.node_tensor import NUM_FIXED_DIMS, PODS
 
 NO_NODE = -1
 
-_PODS_COL = 3  # tensors/node_tensor.py PODS: the pod-count dimension
+_PODS_COL = PODS  # the pod-count dimension of the node tensor
 
 
 def _fits(free: jnp.ndarray, pod_req: jnp.ndarray) -> jnp.ndarray:
@@ -59,7 +60,7 @@ def _fits(free: jnp.ndarray, pod_req: jnp.ndarray) -> jnp.ndarray:
     # scalar/extended columns (>= NUM_FIXED_DIMS) are only checked when the
     # pod actually requests them: fit.go iterates podRequest.ScalarResources,
     # unlike the fixed cpu/memory/ephemeral checks which are unconditional
-    scalar_skip = (cols >= 4) & (pod_req == 0)
+    scalar_skip = (cols >= NUM_FIXED_DIMS) & (pod_req == 0)
     dim_ok = dim_ok | scalar_skip[None, :]
     nonpods = cols != _PODS_COL
     all_zero = jnp.max(jnp.where(nonpods, pod_req, 0)) == 0

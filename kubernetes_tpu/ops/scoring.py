@@ -507,8 +507,12 @@ def _avoid_score(pod: Pod, node) -> float:
         return 100.0
     for entry in avoids:
         ref = entry.get("podSignature", {}).get("podController", {})
-        if ref.get("kind") == controller.kind and (
-            not ref.get("uid") or ref.get("uid") == controller.uid
+        # exact UID equality: the reference compares the full controller
+        # ref including UID (node_prefer_avoid_pods.go), so a malformed
+        # annotation without a uid never matches
+        if (
+            ref.get("kind") == controller.kind
+            and ref.get("uid") == controller.uid
         ):
             return 0.0
     return 100.0

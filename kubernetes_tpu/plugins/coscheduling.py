@@ -111,6 +111,11 @@ class Coscheduling(Plugin):
 
     # -- Permit: the gang barrier -------------------------------------------
 
+    def permit_relevant(self, pod: Pod) -> bool:
+        """Bulk-commit fast-path predicate: permit() is a no-op for pods
+        without a pod-group label."""
+        return bool(pod.metadata.labels.get(POD_GROUP_LABEL))
+
     def permit(
         self, state: CycleState, pod: Pod, node_name: str
     ) -> Tuple[Optional[Status], float]:

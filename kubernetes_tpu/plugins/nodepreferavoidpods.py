@@ -46,8 +46,11 @@ class NodePreferAvoidPods(Plugin):
             return MAX_NODE_SCORE, None
         for entry in avoids:
             ref = entry.get("podSignature", {}).get("podController", {})
-            if ref.get("kind") == controller.kind and (
-                not ref.get("uid") or ref.get("uid") == controller.uid
+            # exact UID equality (node_prefer_avoid_pods.go): an entry
+            # without a uid matches nothing
+            if (
+                ref.get("kind") == controller.kind
+                and ref.get("uid") == controller.uid
             ):
                 return 0, None
         return MAX_NODE_SCORE, None

@@ -16,3 +16,9 @@ class PrioritySort(Plugin):
         if p1 != p2:
             return p1 > p2
         return a.timestamp < b.timestamp
+
+    def queue_sort_key(self, pi: PodInfo):
+        """Total-order key equivalent to ``queue_sort_less`` -- lets the
+        activeQ heap compare natively (C tuple compare) instead of calling
+        back into Python per comparison."""
+        return (-pi.pod.spec.priority, pi.timestamp)

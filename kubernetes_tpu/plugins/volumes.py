@@ -437,6 +437,11 @@ class VolumeBinding(Plugin):
             return None
         return self.binder.find_pod_volumes(pod, node_info)
 
+    def pre_bind_relevant(self, pod: Pod) -> bool:
+        """Bulk-commit fast-path predicate: pre_bind() is a no-op for
+        pods without PVC volumes."""
+        return any(v.pvc_claim_name for v in pod.spec.volumes)
+
     def pre_bind(
         self, state: CycleState, pod: Pod, node_name: str
     ) -> Optional[Status]:

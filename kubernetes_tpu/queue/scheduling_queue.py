@@ -85,6 +85,7 @@ class PriorityQueue:
         pod_initial_backoff: float = DEFAULT_POD_INITIAL_BACKOFF,
         pod_max_backoff: float = DEFAULT_POD_MAX_BACKOFF,
         now: Callable[[], float] = time.monotonic,
+        sort_key_func=None,
     ) -> None:
         self._now = now
         self._lock = threading.RLock()
@@ -92,11 +93,12 @@ class PriorityQueue:
         self._initial_backoff = pod_initial_backoff
         self._max_backoff = pod_max_backoff
 
-        self.active_q = Heap(_info_key, less_func)
-        self.pod_backoff_q = Heap(
-            _info_key,
-            lambda a, b: self._backoff_time(a) < self._backoff_time(b),
-        )
+        # sort_key_func (when the QueueSort plugin provides a total-order
+        # key) lets both heaps compare natively; backoff order is keyed by
+        # the expiry time, snapshotted at insert (timestamp/attempts are
+        # only mutated before re-adding, so the snapshot stays valid)
+        self.active_q = Heap(_info_key, less_func, sort_key=sort_key_func)
+        self.pod_backoff_q = Heap(_info_key, sort_key=self._backoff_time)
         self.unschedulable_q: Dict[str, PodInfo] = {}
         self.nominated_pods = _NominatedPodMap()
 
@@ -414,6 +416,16 @@ class PriorityQueue:
     def delete_nominated_pod_if_exists(self, pod: Pod) -> None:
         with self._lock:
             self.nominated_pods.delete(pod)
+
+    def delete_nominated_pods_if_exist(self, pods: List[Pod]) -> None:
+        """Bulk variant for the batch commit: one lock hold, and an O(1)
+        exit when nothing is nominated (the common case -- a freshly
+        popped batch has no nominations)."""
+        with self._lock:
+            if not self.nominated_pods.nominated_pod_to_node:
+                return
+            for pod in pods:
+                self.nominated_pods.delete(pod)
 
     def all_nominated_pods_by_node(self) -> Dict[str, List[Pod]]:
         """Locked snapshot of the nominated map (node -> pods); the batch

@@ -602,20 +602,75 @@ class BatchScheduler(Scheduler):
         snapshot,
         pod_scheduling_cycle: int,
     ) -> None:
-        """Post-solve pipeline for the whole batch: per pod Reserve ->
-        assume -> Permit inline (scheduler.go:615-660 semantics preserved),
-        then ONE async binding task that commits every default-binder pod
-        in a single bulk transaction; non-default binds (extenders, custom
-        bind plugins, Permit waiters) take the per-pod binding cycle."""
+        """Post-solve pipeline for the whole batch: Reserve -> assume ->
+        Permit (scheduler.go:615-660 semantics preserved), then ONE async
+        binding task that commits every default-binder pod in a single
+        bulk transaction; non-default binds (extenders, custom bind
+        plugins, Permit waiters) take the per-pod binding cycle.
+
+        Pods for which every Reserve/Permit plugin is a declared no-op
+        (Framework.plugins_relevant) skip the per-pod plugin pipeline and
+        are assumed in one bulk cache transaction -- the batch commit is
+        otherwise the profile-run hot loop of the 10k burst."""
         b = len(solver_infos)
-        bulk: List[Tuple] = []
+        # schedule_batch flushes at profile boundaries, so the whole batch
+        # shares one profile (batch.py:242)
+        prof = self.profiles.get(solver_infos[0].pod.spec.scheduler_name)
+        if prof is None:
+            logger.error(
+                "no profile for %s", solver_infos[0].pod.key()
+            )
+            return
+        extenders = self.algorithm.extenders
+        bulk_ok = (
+            prof.uses_default_binder_only() and self._bind_pool is not None
+        )
+
+        plain: List[Tuple[PodInfo, str]] = []  # (pod_info, host)
+        slow: List[Tuple[PodInfo, int]] = []  # (pod_info, choice)
         for k in range(b):
             pi = solver_infos[int(order[k])]
             choice = int(assignments[k])
-            prof = self.profiles.get(pi.pod.spec.scheduler_name)
-            if prof is None:
-                logger.error("no profile for %s", pi.pod.key())
+            if choice == NO_NODE:
+                slow.append((pi, choice))
                 continue
+            pod = pi.pod
+            if (
+                bulk_ok
+                and not prof.plugins_relevant("reserve", pod)
+                and not prof.plugins_relevant("permit", pod)
+                and not any(
+                    e.is_binder() and e.is_interested(pod) for e in extenders
+                )
+            ):
+                plain.append((pi, names[choice]))
+            else:
+                slow.append((pi, choice))
+
+        bulk: List[Tuple] = []
+        if plain:
+            clones = []
+            for pi, host in plain:
+                assumed = pi.pod.assumed_clone()
+                assumed.spec.node_name = host
+                clones.append(assumed)
+            errs = self.cache.assume_pods(clones)
+            self.queue.delete_nominated_pods_if_exist(clones)
+            for (pi, host), assumed, err in zip(plain, clones, errs):
+                if err is not None:
+                    self.record_scheduling_failure(
+                        prof, pi, str(err), "SchedulerError", "",
+                        pod_scheduling_cycle,
+                    )
+                    continue
+                # fresh CycleState per pod: pre_bind/unreserve/post_bind
+                # plugins may write per-pod state (the framework contract)
+                state = CycleState()
+                state.write(SNAPSHOT_STATE_KEY, snapshot)
+                bulk.append((prof, state, pi, assumed, host))
+            self.pods_solved_on_device += len(plain)
+
+        for pi, choice in slow:
             state = CycleState()
             state.write(SNAPSHOT_STATE_KEY, snapshot)
             if choice == NO_NODE:
@@ -640,7 +695,7 @@ class BatchScheduler(Scheduler):
             waiting = prof.get_waiting_pod(assumed.metadata.uid) is not None
             binder_extender = any(
                 e.is_binder() and e.is_interested(assumed)
-                for e in self.algorithm.extenders
+                for e in extenders
             )
             if (
                 waiting
@@ -682,10 +737,15 @@ class BatchScheduler(Scheduler):
     def _bulk_binding_cycle(self, items, pod_scheduling_cycle) -> None:
         """One API transaction commits the batch (the pipelined bulk
         analogue of BindingREST.Create, storage.go:142). PreBind still
-        runs per pod; per-binding conflicts fail only their own pod."""
+        runs per pod (skipped when every PreBind plugin declares itself
+        a no-op for the pod); per-binding conflicts fail only their own
+        pod."""
         ready = []
         for prof, state, pi, assumed, host in items:
-            status = prof.run_pre_bind_plugins(state, assumed, host)
+            if prof.plugins_relevant("pre_bind", assumed):
+                status = prof.run_pre_bind_plugins(state, assumed, host)
+            else:
+                status = None
             if status is not None and not status.is_success():
                 self._forget(assumed)
                 prof.run_unreserve_plugins(state, assumed, host)
@@ -709,6 +769,7 @@ class BatchScheduler(Scheduler):
         bind_timer = metrics.SinceTimer(metrics.binding_duration)
         results = self.client.bind_bulk(bindings)
         bind_timer.observe()
+        bound = []
         for (prof, state, pi, assumed, host), (pod, err) in zip(ready, results):
             if err is not None:
                 metrics.schedule_attempts.inc(result="error")
@@ -719,8 +780,27 @@ class BatchScheduler(Scheduler):
                     pod_scheduling_cycle,
                 )
                 continue
-            self.cache.finish_binding(assumed)
-            self._record_bind_success(prof, state, pi, assumed, host)
+            bound.append((prof, state, pi, assumed, host))
+        if not bound:
+            return
+        self.cache.finish_binding_bulk([a for _, _, _, a, _ in bound])
+        prof0 = bound[0][0]
+        if prof0.has_plugins("post_bind"):
+            for prof, state, pi, assumed, host in bound:
+                prof.run_post_bind_plugins(state, assumed, host)
+        # batched success metrics (one lock hold per histogram)
+        metrics.schedule_attempts.inc(len(bound), result="scheduled")
+        metrics.pod_scheduling_attempts.observe_many(
+            [pi.attempts for _, _, pi, _, _ in bound]
+        )
+        now = time.monotonic()
+        metrics.pod_scheduling_duration.observe_many(
+            [
+                max(0.0, now - pi.initial_attempt_timestamp)
+                for _, _, pi, _, _ in bound
+                if pi.initial_attempt_timestamp
+            ]
+        )
 
     # -- warmup --------------------------------------------------------------
 

@@ -499,7 +499,10 @@ def new_scheduler(
         frameworks[profile_cfg.scheduler_name] = fw
 
     first_fw = next(iter(frameworks.values()))
-    queue = PriorityQueue(first_fw.queue_sort_less_func())
+    queue = PriorityQueue(
+        first_fw.queue_sort_less_func(),
+        sort_key_func=first_fw.queue_sort_key_func(),
+    )
     algorithm.nominated_pods_lister = queue
 
     if batch:

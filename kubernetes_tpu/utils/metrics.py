@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import threading
 import time
+from bisect import bisect_left
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 _DEF_BUCKETS = (
@@ -37,7 +38,7 @@ class Counter:
         self._lock = threading.Lock()
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
-        key = _label_key(labels)
+        key = () if not labels else _label_key(labels)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
@@ -105,14 +106,29 @@ class Histogram:
         self._lock = threading.Lock()
 
     def observe(self, value: float, **labels: str) -> None:
-        key = _label_key(labels)
+        # counts are stored per-bucket (first bucket the value falls in);
+        # the Prometheus cumulative form is materialized in collect() --
+        # one bisect instead of a Python loop over every bucket
+        key = () if not labels else _label_key(labels)
         with self._lock:
-            counts = self._counts.setdefault(key, [0] * len(self.buckets))
-            for i, b in enumerate(self.buckets):
-                if value <= b:
-                    counts[i] += 1
+            counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
+            counts[bisect_left(self.buckets, value)] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+
+    def observe_many(self, values: Sequence[float], **labels: str) -> None:
+        """Bulk observe under one lock (the batch-commit hot path)."""
+        if not values:
+            return
+        key = () if not labels else _label_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
+            total = 0.0
+            for v in values:
+                counts[bisect_left(self.buckets, v)] += 1
+                total += v
+            self._sums[key] = self._sums.get(key, 0.0) + total
+            self._totals[key] = self._totals.get(key, 0) + len(values)
 
     def count(self, **labels: str) -> int:
         return self._totals.get(_label_key(labels), 0)
@@ -127,11 +143,13 @@ class Histogram:
         ]
         with self._lock:
             for key in sorted(self._totals):
+                cumulative = 0
                 for i, b in enumerate(self.buckets):
+                    cumulative += self._counts[key][i]
                     out.append(
                         f"{self.name}_bucket"
                         f"{_fmt_labels(key, f'le=\"{b}\"')} "
-                        f"{self._counts[key][i]}"
+                        f"{cumulative}"
                     )
                 out.append(
                     f"{self.name}_bucket{_fmt_labels(key, 'le=\"+Inf\"')} "

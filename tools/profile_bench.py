@@ -1,0 +1,54 @@
+"""Profile the scheduler thread during the bench burst.
+
+cProfile is attached to the BatchScheduler.run thread (the solver +
+commit hot path) and, separately, to the bind-pool workers. Emits
+profile_scheduler.txt (cumulative + tottime views) next to this file.
+
+Usage: python tools/profile_bench.py  (env knobs same as bench.py)
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import os
+import pstats
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+
+
+def main() -> None:
+    out_dir = os.path.dirname(os.path.abspath(__file__))
+    prof = cProfile.Profile()
+    orig_run = BatchScheduler.run
+
+    def run_profiled(self):
+        prof.enable()
+        try:
+            orig_run(self)
+        finally:
+            prof.disable()
+
+    BatchScheduler.run = run_profiled
+
+    import bench
+
+    bench.main()
+
+    prof.dump_stats(os.path.join(out_dir, "profile_scheduler.prof"))
+    buf = io.StringIO()
+    st = pstats.Stats(prof, stream=buf)
+    buf.write("==== cumulative ====\n")
+    st.sort_stats("cumulative").print_stats(45)
+    buf.write("\n==== tottime ====\n")
+    st.sort_stats("tottime").print_stats(45)
+    with open(os.path.join(out_dir, "profile_scheduler.txt"), "w") as f:
+        f.write(buf.getvalue())
+    print("profile written to tools/profile_scheduler.txt", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""Low-overhead phase timing for the bench burst.
+
+Wraps the hot pipeline stages with perf_counter accumulators (no
+tracing): pack, upload+dispatch, result download, commit loop, bulk
+bind, API create, informer apply. Prints a per-phase table after the
+bench line. Overhead is a few ns per call, so the bench number stays
+representative (unlike cProfile, which cut throughput ~3x).
+
+Usage: python tools/time_bench.py  (env knobs same as bench.py)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ACC = defaultdict(float)
+CNT = defaultdict(int)
+
+
+def timed(name, fn):
+    def wrapper(*a, **kw):
+        t0 = time.perf_counter()
+        try:
+            return fn(*a, **kw)
+        finally:
+            ACC[name] += time.perf_counter() - t0
+            CNT[name] += 1
+
+    return wrapper
+
+
+def main() -> None:
+    import kubernetes_tpu.scheduler.batch as batch_mod
+    import kubernetes_tpu.tensors as tensors_mod
+    from kubernetes_tpu.scheduler.batch import BatchScheduler
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.client.informer import Informer
+
+    # stage wrappers inside the batch module namespace
+    batch_mod.pack_pod_batch = timed("pack_pod_batch", batch_mod.pack_pod_batch)
+    batch_mod.static_mask_compact = timed(
+        "static_mask_compact", batch_mod.static_mask_compact
+    )
+    batch_mod.pack_score_batch = timed(
+        "pack_score_batch", batch_mod.pack_score_batch
+    )
+    BatchScheduler._dispatch_solve = timed(
+        "dispatch_solve_total", BatchScheduler._dispatch_solve
+    )
+    BatchScheduler._complete_solve = timed(
+        "complete_solve_total", BatchScheduler._complete_solve
+    )
+    BatchScheduler._commit_batch = timed(
+        "commit_batch", BatchScheduler._commit_batch
+    )
+    BatchScheduler._bulk_binding_cycle = timed(
+        "bulk_binding_cycle", BatchScheduler._bulk_binding_cycle
+    )
+    Scheduler.reserve_assume_permit = timed(
+        "reserve_assume_permit", Scheduler.reserve_assume_permit
+    )
+    APIServer.create = timed("apiserver.create", APIServer.create)
+    APIServer.bind_bulk = timed("apiserver.bind_bulk", APIServer.bind_bulk)
+    Informer._apply = timed("informer._apply", Informer._apply)
+    batch_mod.jax.device_put = timed("jax.device_put", batch_mod.jax.device_put)
+
+    import kubernetes_tpu.queue.scheduling_queue as q_mod
+
+    q_mod.PriorityQueue.pop_batch = timed(
+        "queue.pop_batch", q_mod.PriorityQueue.pop_batch
+    )
+
+    import bench
+
+    bench.main()
+
+    print("\nphase timings (s, calls):", file=sys.stderr)
+    for name in sorted(ACC, key=lambda k: -ACC[k]):
+        print(f"  {name:28s} {ACC[name]:8.3f}  x{CNT[name]}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
