@@ -1,0 +1,12 @@
+"""Perf-matrix benchmark harness.
+
+The analogue of the reference's BenchmarkPerfScheduling
+(/root/reference/test/integration/scheduler_perf/scheduler_perf_test.go:112):
+a YAML workload matrix (config/performance-config.yaml) driven end-to-end
+through the real pipeline (apiserver -> informers -> queue -> TPU batch
+solver -> bulk bind), emitting DataItems-style JSON
+(test/integration/scheduler_perf/util.go:109) with throughput samples and
+pod-to-bind latency percentiles per workload.
+
+Run: python -m benchmarks [--config PATH] [--out PATH] [--only NAME]
+"""

@@ -1,0 +1,404 @@
+"""Perf-matrix runner: drive every workload in the YAML config through
+the full pipeline and emit DataItems JSON.
+
+Mirrors the reference harness end to end:
+- workload matrix     ~ test/integration/scheduler_perf/config/
+                        performance-config.yaml
+- throughput sampling ~ util.go:197 throughputCollector (1s windows)
+- DataItems output    ~ util.go:109 (dataItems with labels + unit)
+- init-pods warm fill ~ scheduler_perf_test.go:130 perfScheduling
+
+Solver-path counters (pods on device, fallbacks, envelope fallbacks,
+pipeline drains, carry reuse) ride in each item's labels so the
+batch-path cliffs VERDICT r2 flagged are visible per workload.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes_tpu.api.types import (
+    POD_GROUP_LABEL,
+    ObjectMeta,
+    PodGroup,
+    Service,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.ops.assignment import GreedyConfig
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+ZONE_LABEL = "topology.kubernetes.io/zone"
+HOSTNAME_LABEL = "kubernetes.io/hostname"
+
+
+class BindCollector:
+    """Event-driven throughput + latency collector over a Pod watch
+    stream (the reference polls the informer once per second,
+    util.go:228; a watch gives the same samples without polling)."""
+
+    def __init__(self, server: APIServer, targets) -> None:
+        self._watch = server.watch("Pod", since_rv=server.current_rv())
+        self.bind_times: Dict[str, float] = {}
+        self._cond = threading.Condition()
+        self._stop = False
+        self._targets = set(targets)
+        self._outstanding = len(self._targets)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop:
+            evs = self._watch.next_batch(timeout=0.2)
+            if not evs:
+                continue
+            now = time.perf_counter()
+            with self._cond:
+                for ev in evs:
+                    if ev.type != "MODIFIED":
+                        continue
+                    pod = ev.object
+                    if not pod.spec.node_name:
+                        continue
+                    name = pod.metadata.name
+                    if name in self.bind_times:
+                        continue
+                    self.bind_times[name] = now
+                    if name in self._targets:
+                        self._outstanding -= 1
+                if self._outstanding <= 0:
+                    self._cond.notify_all()
+
+    def wait(self, timeout: float) -> bool:
+        deadline = time.time() + timeout
+        with self._cond:
+            while self._outstanding > 0:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 0.5))
+            return True
+
+    def stop(self) -> None:
+        self._stop = True
+        self._watch.stop()
+        self._thread.join(timeout=2)
+
+
+def _percentile(sorted_vals: List[float], p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(len(sorted_vals) * p / 100.0))
+    return sorted_vals[idx]
+
+
+def _build_pod(name: str, spec: Dict[str, Any], idx: int):
+    w = make_pod(name)
+    w.container(
+        cpu=str(spec.get("cpu", "100m")),
+        memory=str(spec.get("memory", "128Mi")),
+        **{
+            k.replace("/", "__").replace(".", "_"): v
+            for k, v in (spec.get("scalars") or {}).items()
+        },
+    )
+    if spec.get("labels"):
+        w.labels(**spec["labels"])
+    if spec.get("priority") is not None:
+        w.priority(int(spec["priority"]))
+    sp = spec.get("spread")
+    if sp:
+        w.spread_constraint(
+            max_skew=int(sp.get("max_skew", 1)),
+            topology_key=sp.get("topology_key", ZONE_LABEL),
+            when_unsatisfiable=sp.get("when_unsatisfiable", "DoNotSchedule"),
+            match_labels=sp.get("match_labels") or {},
+        )
+    af = spec.get("affinity")
+    if af:
+        if af.get("preferred"):
+            w.preferred_pod_affinity(
+                topology_key=af.get("topology_key", ZONE_LABEL),
+                match_labels=af.get("match_labels") or {},
+                weight=int(af.get("weight", 1)),
+                anti=bool(af.get("anti")),
+            )
+        else:
+            w.pod_affinity(
+                topology_key=af.get("topology_key", ZONE_LABEL),
+                match_labels=af.get("match_labels") or {},
+                anti=bool(af.get("anti")),
+            )
+    return w.obj()
+
+
+def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]:
+    name = wl["name"]
+    num_nodes = int(wl["nodes"])
+    zones = int(wl.get("zones", defaults.get("zones", 10)))
+    max_batch = int(wl.get("max_batch", defaults.get("max_batch", 1024)))
+    timeout_s = float(wl.get("timeout_s", defaults.get("timeout_s", 420)))
+    node_spec = wl.get("node") or {}
+
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    solver_cfg = GreedyConfig(**wl["solver"]) if wl.get("solver") else None
+    sched = new_scheduler(
+        client,
+        informers,
+        batch=True,
+        max_batch=max_batch,
+        solver_config=solver_cfg,
+        solver_mode=wl.get("solver_mode", "greedy"),
+    )
+
+    for i in range(num_nodes):
+        nw = make_node(f"node-{i}").capacity(
+            cpu=str(node_spec.get("cpu", defaults.get("node_cpu", "32"))),
+            memory=str(node_spec.get("memory", defaults.get("node_memory", "64Gi"))),
+            pods=int(node_spec.get("pods", defaults.get("node_pods", 110))),
+            **{
+                k.replace("/", "__").replace(".", "_"): v
+                for k, v in (node_spec.get("scalars") or {}).items()
+            },
+        )
+        nw.label(ZONE_LABEL, f"zone-{i % zones}")
+        nw.label(HOSTNAME_LABEL, f"node-{i}")
+        client.create_node(nw.obj())
+
+    for svc in wl.get("services") or []:
+        server.create(
+            Service(
+                metadata=ObjectMeta(name=svc["name"], namespace="default"),
+                selector=dict(svc.get("selector") or {}),
+            )
+        )
+
+    gang = wl.get("gang")
+    measure_pods = int(wl["measure_pods"])
+    if gang:
+        group_size = int(gang.get("group_size", 10))
+        for g in range(-(-measure_pods // group_size)):
+            server.create(
+                PodGroup(
+                    metadata=ObjectMeta(name=f"group-{g}", namespace="default"),
+                    min_member=int(gang.get("min_member", group_size)),
+                )
+            )
+
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    sched.warmup()
+
+    # -- init fill (off the clock) ------------------------------------------
+    init_spec = wl.get("init_pod") or wl.get("pod") or {}
+    init_n = int(wl.get("init_pods", 0))
+    if init_n:
+        init_names = [f"init-{i}" for i in range(init_n)]
+        coll = BindCollector(server, init_names)
+        for i, nm in enumerate(init_names):
+            client.create_pod(_build_pod(nm, init_spec, i))
+        t = sched.start()
+        if not coll.wait(timeout_s):
+            coll.stop()
+            sched.stop()
+            informers.stop()
+            return {"name": name, "error": "init pods did not all schedule"}
+        coll.stop()
+    else:
+        t = sched.start()
+
+    # -- measured burst -------------------------------------------------------
+    pod_spec = wl.get("pod") or {}
+    pods = []
+    for i in range(measure_pods):
+        p = _build_pod(f"measure-{i}", pod_spec, i)
+        if gang:
+            p.metadata.labels[POD_GROUP_LABEL] = (
+                f"group-{i // int(gang.get('group_size', 10))}"
+            )
+        pods.append(p)
+
+    churn = wl.get("churn")
+    target_names = [p.metadata.name for p in pods]
+    coll = BindCollector(server, target_names)
+    create_times: Dict[str, float] = {}
+
+    start = time.perf_counter()
+    ok = True
+    if churn:
+        # BASELINE #5: steady-state churn -- delete a slice of running
+        # pods and schedule replacements, round after round
+        rounds = int(churn.get("rounds", 5))
+        per_round = int(churn.get("delete_per_round", len(pods) // rounds))
+        chunks = [
+            pods[r * len(pods) // rounds: (r + 1) * len(pods) // rounds]
+            for r in range(rounds)
+        ]
+        running, _ = client.list_pods()
+        victims = [p for p in running if p.spec.node_name]
+        vi = 0
+        for r, chunk in enumerate(chunks):
+            for _ in range(min(per_round, len(victims) - vi)):
+                v = victims[vi]
+                vi += 1
+                client.delete_pod(v.metadata.namespace, v.metadata.name)
+            for p in chunk:
+                create_times[p.metadata.name] = time.perf_counter()
+                client.create_pod(p)
+            # wait for this round's chunk before the next delete wave
+            round_deadline = time.time() + timeout_s / rounds
+            while time.time() < round_deadline:
+                with coll._cond:
+                    if all(
+                        p.metadata.name in coll.bind_times for p in chunk
+                    ):
+                        break
+                time.sleep(0.02)
+        ok = coll.wait(timeout_s)
+    else:
+        for p in pods:
+            create_times[p.metadata.name] = time.perf_counter()
+            client.create_pod(p)
+        ok = coll.wait(timeout_s)
+    elapsed = time.perf_counter() - start
+    sched.wait_for_inflight_binds(timeout=60)
+    coll.stop()
+    sched.stop()
+    informers.stop()
+
+    bound = sum(1 for n in target_names if n in coll.bind_times)
+    result: Dict[str, Any] = {
+        "name": name,
+        "ok": bool(ok and bound == len(target_names)),
+        "bound": bound,
+        "total": len(target_names),
+        "elapsed_s": round(elapsed, 3),
+        "throughput_pods_per_s": round(bound / elapsed, 1) if elapsed else 0.0,
+    }
+
+    lat = sorted(
+        coll.bind_times[n] - create_times[n]
+        for n in target_names
+        if n in coll.bind_times and n in create_times
+    )
+    if lat:
+        result["latency_ms"] = {
+            "Perc50": round(_percentile(lat, 50) * 1000, 1),
+            "Perc90": round(_percentile(lat, 90) * 1000, 1),
+            "Perc99": round(_percentile(lat, 99) * 1000, 1),
+        }
+    # 1s-window throughput samples (reference throughputCollector)
+    if coll.bind_times:
+        t0 = min(coll.bind_times.values())
+        windows: Dict[int, int] = {}
+        for v in coll.bind_times.values():
+            windows[int((v - t0))] = windows.get(int(v - t0), 0) + 1
+        samples = sorted(windows.values())
+        result["throughput_samples"] = {
+            "Average": round(sum(samples) / len(samples), 1),
+            "Perc50": _percentile(samples, 50),
+            "Perc90": _percentile(samples, 90),
+            "Perc99": _percentile(samples, 99),
+        }
+    result["solver"] = {
+        "batches": sched.batches_solved,
+        "pods_on_device": sched.pods_solved_on_device,
+        "pods_fallback": sched.pods_fallback,
+        "envelope_fallbacks": sched.envelope_fallbacks,
+        "pipeline_drains": sched.pipeline_drains,
+        "state_reuses": sched.state_reuses,
+        "state_uploads": sched.state_uploads,
+    }
+    return result
+
+
+def to_data_items(results: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The reference dashboard JSON shape (util.go:109 DataItems)."""
+    items = []
+    for r in results:
+        labels = {"Name": r["name"]}
+        labels.update(
+            {f"solver_{k}": str(v) for k, v in (r.get("solver") or {}).items()}
+        )
+        if r.get("error") or not r.get("ok", False):
+            labels["error"] = r.get("error", f"{r.get('bound')}/{r.get('total')} bound")
+        items.append(
+            {
+                "data": {
+                    "Average": r.get("throughput_pods_per_s", 0.0),
+                    **(r.get("throughput_samples") or {}),
+                },
+                "unit": "pods/s",
+                "labels": {**labels, "Metric": "SchedulingThroughput"},
+            }
+        )
+        if r.get("latency_ms"):
+            items.append(
+                {
+                    "data": dict(r["latency_ms"]),
+                    "unit": "ms",
+                    "labels": {**labels, "Metric": "PodToBindLatency"},
+                }
+            )
+    return {"version": "v1", "dataItems": items}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    import yaml
+
+    ap = argparse.ArgumentParser(prog="benchmarks")
+    ap.add_argument(
+        "--config",
+        default=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "config",
+            "performance-config.yaml",
+        ),
+    )
+    ap.add_argument("--out", default="BENCHMARKS.json")
+    ap.add_argument("--only", default="", help="substring filter on workload name")
+    args = ap.parse_args(argv)
+
+    with open(args.config) as f:
+        cfg = yaml.safe_load(f)
+    defaults = cfg.get("defaults") or {}
+    results = []
+    for wl in cfg.get("workloads") or []:
+        if args.only and args.only not in wl["name"]:
+            continue
+        print(f"=== {wl['name']} ===", file=sys.stderr, flush=True)
+        t0 = time.perf_counter()
+        try:
+            r = run_workload(wl, defaults)
+        except Exception as e:  # noqa: BLE001 - keep the matrix running
+            import traceback
+
+            traceback.print_exc()
+            r = {"name": wl["name"], "ok": False, "error": repr(e)}
+        r["wall_s"] = round(time.perf_counter() - t0, 1)
+        print(json.dumps(r), file=sys.stderr, flush=True)
+        results.append(r)
+
+    out = to_data_items(results)
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0 if all(r.get("ok") for r in results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -186,6 +186,34 @@ class APIServer:
             self._broadcast(kind, WatchEvent(ADDED, obj, obj.metadata.resource_version))
             return obj
 
+    def create_bulk(self, objs: List[Any]) -> List[Any]:
+        """Create many objects of one kind in a single store transaction
+        with one bulk watch fan-out -- the ingestion analogue of
+        bind_bulk. All-or-nothing per object (a conflict raises after none
+        of the later objects are applied), matching N sequential creates
+        that stop at the first failure."""
+        if not objs:
+            return objs
+        kind = objs[0].kind
+        events: List[WatchEvent] = []
+        with self._lock:
+            self._ensure_kind(kind)
+            store = self._stores[kind]
+            for obj in objs:
+                if obj.kind != kind:
+                    raise ValueError("create_bulk objects must share a kind")
+                key = _obj_key(obj)
+                if key in store:
+                    self._broadcast_many(kind, events)
+                    raise Conflict(f"{kind} {key} already exists")
+                obj.metadata.resource_version = self._next_rv()
+                store[key] = obj
+                events.append(
+                    WatchEvent(ADDED, obj, obj.metadata.resource_version)
+                )
+            self._broadcast_many(kind, events)
+        return objs
+
     def get(self, kind: str, namespace: str, name: str) -> Any:
         with self._lock:
             self._ensure_kind(kind)

@@ -22,6 +22,10 @@ class Client:
     def create_pod(self, pod: Pod) -> Pod:
         return self._server.create(pod)
 
+    def create_pods_bulk(self, pods: List[Pod]) -> List[Pod]:
+        """One store transaction + one watch fan-out for a pod burst."""
+        return self._server.create_bulk(pods)
+
     def get_pod(self, namespace: str, name: str) -> Pod:
         return self._server.get("Pod", namespace, name)
 

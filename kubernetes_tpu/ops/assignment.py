@@ -42,6 +42,13 @@ from kubernetes_tpu.tensors.node_tensor import NUM_FIXED_DIMS, PODS
 
 NO_NODE = -1
 
+# lax.scan per-iteration dispatch overhead dominates the tiny per-pod
+# step at bench shapes (~50us/step for a [5000, 8] mask+score); XLA
+# unrolling amortizes it across UNROLL pods per loop trip
+import os as _os
+
+SCAN_UNROLL = int(_os.environ.get("KTPU_SCAN_UNROLL", "8"))
+
 _PODS_COL = PODS  # the pod-count dimension of the node tensor
 
 
@@ -82,6 +89,32 @@ class GreedyConfig:
     most_allocated_weight: int = 0
 
 
+def _combined_score(caps, nzr_state, p_nzr, config) -> jnp.ndarray:
+    """Weighted resource score for one pod against node state of any
+    leading shape: caps/nzr_state [..., 2], p_nzr [2]. Elementwise ops
+    only, so the [N] batch form and the single-node form run the exact
+    same arithmetic (bit-identical on device)."""
+    score = None
+    if config.least_allocated_weight:
+        s = config.least_allocated_weight * least_allocated_score(
+            caps, nzr_state, p_nzr[None, :]
+        )[0]
+        score = s if score is None else score + s
+    if config.balanced_allocation_weight:
+        s = config.balanced_allocation_weight * balanced_allocation_score(
+            caps, nzr_state, p_nzr[None, :]
+        )[0]
+        score = s if score is None else score + s
+    if config.most_allocated_weight:
+        s = config.most_allocated_weight * most_allocated_score(
+            caps, nzr_state, p_nzr[None, :]
+        )[0]
+        score = s if score is None else score + s
+    if score is None:
+        score = jnp.zeros(caps.shape[:-1], dtype=jnp.float32)
+    return score
+
+
 def _greedy_assign_impl(
     allocatable: jnp.ndarray,  # [N, R] int32
     requested: jnp.ndarray,  # [N, R] int32 (batch-start state)
@@ -95,7 +128,12 @@ def _greedy_assign_impl(
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Returns (assignment [B] int32 node index or NO_NODE,
     requested' [N, R], nzr' [N, 2]) -- the post-batch node state so the
-    host can incrementally reconcile instead of repacking."""
+    host can incrementally reconcile instead of repacking.
+
+    (An incremental same-pod variant -- recompute only the previously
+    chosen node's score/fit row under a lax.cond -- measured SLOWER on
+    the real chip: 97ms -> 176ms for 2048x5000, the conditional defeats
+    XLA's fusion of the step. The straight full-recompute scan stays.)"""
     caps = allocatable[:, :2]  # (milliCPU, memKiB) capacities for scorers
     n = allocatable.shape[0]
     node_iota = jnp.arange(n, dtype=jnp.int32)
@@ -107,21 +145,7 @@ def _greedy_assign_impl(
         free = allocatable - req_state
         fits = _fits(free, pod_req)
         feasible = fits & smask & valid
-
-        score = jnp.zeros((n,), dtype=jnp.float32)
-        if config.least_allocated_weight:
-            score += config.least_allocated_weight * least_allocated_score(
-                caps, nzr_state, p_nzr[None, :]
-            )[0]
-        if config.balanced_allocation_weight:
-            score += (
-                config.balanced_allocation_weight
-                * balanced_allocation_score(caps, nzr_state, p_nzr[None, :])[0]
-            )
-        if config.most_allocated_weight:
-            score += config.most_allocated_weight * most_allocated_score(
-                caps, nzr_state, p_nzr[None, :]
-            )[0]
+        score = _combined_score(caps, nzr_state, p_nzr, config)
 
         score = jnp.where(feasible, score, -jnp.inf)
         choice = jnp.argmax(score).astype(jnp.int32)
@@ -137,6 +161,7 @@ def _greedy_assign_impl(
         step,
         (requested, nzr),
         (pod_requests, pod_nzr, static_mask, active),
+        unroll=SCAN_UNROLL,
     )
     return assignments, req_out, nzr_out
 
@@ -171,7 +196,8 @@ def _greedy_assign_scored_impl(
         return req_state, assignment
 
     req_out, assignments = jax.lax.scan(
-        step, requested, (pod_requests, static_mask, active, score_matrix)
+        step, requested, (pod_requests, static_mask, active, score_matrix),
+        unroll=SCAN_UNROLL,
     )
     return assignments, req_out
 
@@ -274,6 +300,7 @@ def _greedy_assign_spread_impl(
             pod_requests, pod_nzr, static_mask, active,
             pod_groups, pod_max_skew, pod_self, pod_match,
         ),
+        unroll=SCAN_UNROLL,
     )
     return assignments, req_out, nzr_out, counts_out
 
@@ -306,6 +333,75 @@ def greedy_assign_compact(
     return _greedy_assign_impl(
         allocatable, requested, nzr, valid, pod_requests, pod_nzr,
         mask_rows[mask_index], active, config=config,
+    )
+
+
+@partial(jax.jit, static_argnames=("layout", "config", "mode"))
+def _solve_packed_jit(
+    buf: jnp.ndarray,  # [T] int32: every uploaded piece, concatenated
+    alloc_in,  # [N, R] int32 device-resident, or None when in buf
+    valid_in,  # [N] bool device-resident, or None when in buf
+    req_in,  # [N, R] int32 carried device state, or None when in buf
+    nzr_in,  # [N, 2] int32 carried device state, or None when in buf
+    layout: Tuple,  # static ((name, shape), ...) describing buf slices
+    config: GreedyConfig = GreedyConfig(),
+    mode: str = "greedy",
+):
+    """Solve from a SINGLE uploaded buffer.
+
+    Over the serving link every device_put operand pays its own
+    round-trip (measured ~40-90ms each on the tunneled chip, ~340ms for
+    the batch's 5-9 arrays); concatenating the per-batch upload into one
+    int32 buffer makes it one transfer and this wrapper re-slices it
+    on device (static offsets, free after fusion). Returns
+    (assignment, requested', nzr', allocatable, valid) -- the last two
+    so the caller can keep device-resident refs when they rode the
+    buffer."""
+    arrs = {}
+    off = 0
+    for name, shape in layout:
+        size = 1
+        for d in shape:
+            size *= d
+        arrs[name] = buf[off:off + size].reshape(shape)
+        off += size
+    alloc = arrs["alloc"] if "alloc" in arrs else alloc_in
+    valid = arrs["valid"].astype(bool) if "valid" in arrs else valid_in
+    req_state = arrs["req_state"] if "req_state" in arrs else req_in
+    nzr_state = arrs["nzr_state"] if "nzr_state" in arrs else nzr_in
+    pod_req = arrs["req"]
+    pod_nzr_ = arrs["nzr"]
+    midx = arrs["midx"]
+    active = arrs["active"].astype(bool)
+    rows = arrs["rows"].astype(bool)
+    solver = sinkhorn_assign if mode == "sinkhorn" else greedy_assign_compact
+    assignment, req_out, nzr_out = solver(
+        alloc, req_state, nzr_state, valid, pod_req, pod_nzr_, rows, midx,
+        active, config=config,
+    )
+    return assignment, req_out, nzr_out, alloc, valid
+
+
+def solve_packed(
+    pieces,  # ordered [(name, np.int32 ndarray)] to ride the buffer
+    alloc_in,
+    valid_in,
+    req_in,
+    nzr_in,
+    config: GreedyConfig = GreedyConfig(),
+    mode: str = "greedy",
+):
+    """Host-side companion of _solve_packed_jit: concatenates the pieces
+    (all int32, bools pre-cast by the caller) and dispatches one upload +
+    one solve."""
+    import numpy as _np
+
+    layout = tuple((name, arr.shape) for name, arr in pieces)
+    buf = _np.concatenate([arr.ravel() for _, arr in pieces])
+    buf_d = jax.device_put(buf)
+    return _solve_packed_jit(
+        buf_d, alloc_in, valid_in, req_in, nzr_in,
+        layout=layout, config=config, mode=mode,
     )
 
 
@@ -640,7 +736,7 @@ def greedy_assign_constrained(
         sc_pod_soft_groups, sc_pod_soft_match,
     )
     (req_out, nzr_out, _, _, _, _, _, _), assignments = jax.lax.scan(
-        step, carry0, xs
+        step, carry0, xs, unroll=SCAN_UNROLL
     )
     return assignments, req_out, nzr_out
 def make_sharded_solver(mesh: "jax.sharding.Mesh", config: GreedyConfig = GreedyConfig()):
@@ -750,6 +846,7 @@ def sinkhorn_assign(
         return (req_state, nzr_state), assignment
 
     (req_out, nzr_out), assignments = jax.lax.scan(
-        step, (requested, nzr), (pod_requests, pod_nzr, sm, active, refined)
+        step, (requested, nzr), (pod_requests, pod_nzr, sm, active, refined),
+        unroll=SCAN_UNROLL,
     )
     return assignments, req_out, nzr_out

@@ -67,8 +67,23 @@ def main() -> None:
     )
     APIServer.create = timed("apiserver.create", APIServer.create)
     APIServer.bind_bulk = timed("apiserver.bind_bulk", APIServer.bind_bulk)
-    Informer._apply = timed("informer._apply", Informer._apply)
+    Informer._apply_batch = timed("informer._apply_batch", Informer._apply_batch)
+    # batch_mod.jax IS the shared jax module: one wrap covers every caller
     batch_mod.jax.device_put = timed("jax.device_put", batch_mod.jax.device_put)
+    batch_mod.solve_packed = timed("solve_packed_dispatch", batch_mod.solve_packed)
+    import numpy as _np
+    _orig_asarray = _np.asarray
+    def _asarray(*a, **kw):
+        import time as _t
+        t0 = _t.perf_counter()
+        try:
+            return _orig_asarray(*a, **kw)
+        finally:
+            dt = _t.perf_counter() - t0
+            if dt > 0.001:
+                ACC["np.asarray(slow)"] += dt
+                CNT["np.asarray(slow)"] += 1
+    batch_mod.np.asarray = _asarray
 
     import kubernetes_tpu.queue.scheduling_queue as q_mod
 
