@@ -53,19 +53,22 @@ class BindWatcher:
 
     def _run(self) -> None:
         while not self._stop:
-            ev = self._watch.next(timeout=0.2)
-            if ev is None:
+            evs = self._watch.next_batch(timeout=0.2)
+            if not evs:
                 continue
-            pod = ev.object
-            if ev.type == "MODIFIED" and pod.spec.node_name:
-                with self._cond:
+            now = time.perf_counter()
+            with self._cond:
+                for ev in evs:
+                    pod = ev.object
+                    if ev.type != "MODIFIED" or not pod.spec.node_name:
+                        continue
                     name = pod.metadata.name
                     if name not in self.bind_times:
-                        self.bind_times[name] = time.perf_counter()
+                        self.bind_times[name] = now
                         if name in self._targets:
                             self._outstanding -= 1
-                            if self._outstanding <= 0:
-                                self._cond.notify_all()
+                if self._outstanding <= 0:
+                    self._cond.notify_all()
 
     def wait_for_targets(self, deadline: float) -> bool:
         with self._cond:

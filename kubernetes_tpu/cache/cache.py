@@ -121,24 +121,38 @@ class SchedulerCache:
 
     # -- confirmed pod events (informer-driven) -----------------------------
 
-    def add_pod(self, pod: Pod) -> None:
+    def _add_pod_locked(self, pod: Pod, strict: bool) -> None:
         key = pod.metadata.uid
-        with self._lock:
-            state = self._pod_states.get(key)
-            if state is not None and state.assumed:
-                # Confirmation of an assumed pod. If the actual node differs,
-                # move it (reference cache.go:419 "was assumed to a different
-                # node": remove then re-add).
-                if state.pod.spec.node_name != pod.spec.node_name:
-                    self._remove_pod_from_node(state.pod)
-                    self._add_pod_to_node(pod)
-                self._pod_states[key] = _PodState(pod=pod, assumed=False)
-                self._assumed_pods.pop(key, None)
-                return
-            if state is not None:
-                raise KeyError(f"pod {pod.key()} already added")
-            self._add_pod_to_node(pod)
+        state = self._pod_states.get(key)
+        if state is not None and state.assumed:
+            # Confirmation of an assumed pod. If the actual node differs,
+            # move it (reference cache.go:419 "was assumed to a different
+            # node": remove then re-add).
+            if state.pod.spec.node_name != pod.spec.node_name:
+                self._remove_pod_from_node(state.pod)
+                self._add_pod_to_node(pod)
             self._pod_states[key] = _PodState(pod=pod, assumed=False)
+            self._assumed_pods.pop(key, None)
+            return
+        if state is not None:
+            if strict:
+                raise KeyError(f"pod {pod.key()} already added")
+            return  # already added (watch replay)
+        self._add_pod_to_node(pod)
+        self._pod_states[key] = _PodState(pod=pod, assumed=False)
+
+    def add_pod(self, pod: Pod) -> None:
+        with self._lock:
+            self._add_pod_locked(pod, strict=True)
+
+    def add_pods(self, pods: List[Pod]) -> None:
+        """Bulk add/confirm under one lock hold (the watch-frame analogue
+        of N add_pod calls); a duplicate add raises in add_pod but is
+        skipped in bulk (the informer can legitimately replay an add
+        after a relist)."""
+        with self._lock:
+            for pod in pods:
+                self._add_pod_locked(pod, strict=False)
 
     def update_pod(self, old: Pod, new: Pod) -> None:
         with self._lock:

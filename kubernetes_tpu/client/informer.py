@@ -35,11 +35,17 @@ class ResourceEventHandler:
         on_update: Optional[Callable[[Any, Any], None]] = None,
         on_delete: Optional[Callable[[Any], None]] = None,
         filter_func: Optional[Callable[[Any], bool]] = None,
+        on_batch: Optional[Callable[[List], None]] = None,
     ):
         self.on_add = on_add
         self.on_update = on_update
         self.on_delete = on_delete
         self.filter_func = filter_func
+        # optional whole-frame handler: receives [(type, old, new)] raw
+        # (unfiltered) and replaces the per-event dispatch -- lets hot
+        # consumers (cache/queue bridges) amortize their locks over a
+        # watch frame; the handler applies filter semantics itself
+        self.on_batch = on_batch
 
     def _passes(self, obj: Any) -> bool:
         return self.filter_func is None or self.filter_func(obj)
@@ -103,9 +109,7 @@ class Informer:
         with self._lock:
             for obj in objs:
                 self._store[(obj.metadata.namespace, obj.metadata.name)] = obj
-        for obj in objs:
-            for h in self._handlers:
-                h.handle(ADDED, None, obj)
+        self._dispatch([(ADDED, None, obj) for obj in objs])
         self.synced = True
 
     def _apply(self, ev: WatchEvent) -> None:
@@ -133,10 +137,15 @@ class Informer:
                 elif ev.type == DELETED:
                     store.pop(key, None)
                     dispatch.append((DELETED, None, obj))
-        handlers = self._handlers
-        for etype, old, obj in dispatch:
-            for h in handlers:
-                h.handle(etype, old, obj)
+        self._dispatch(dispatch)
+
+    def _dispatch(self, dispatch: List) -> None:
+        for h in self._handlers:
+            if h.on_batch is not None:
+                h.on_batch(dispatch)
+            else:
+                for etype, old, obj in dispatch:
+                    h.handle(etype, old, obj)
 
     def pump(self) -> int:
         """Synchronously process pending events; returns count."""

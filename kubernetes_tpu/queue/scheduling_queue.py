@@ -126,15 +126,44 @@ class PriorityQueue:
 
     # -- add paths ----------------------------------------------------------
 
+    def _add_locked(self, pod: Pod, now: float) -> None:
+        key = _pod_key(pod)
+        self.active_q.add(PodInfo(pod, now))
+        self.unschedulable_q.pop(key, None)
+        self.pod_backoff_q.delete_by_key(key)
+        self.nominated_pods.add(pod, "")
+
+    def _delete_locked(self, pod: Pod) -> None:
+        key = _pod_key(pod)
+        self.nominated_pods.delete(pod)
+        self.active_q.delete_by_key(key)
+        self.pod_backoff_q.delete_by_key(key)
+        self.unschedulable_q.pop(key, None)
+
     def add(self, pod: Pod) -> None:
         """New pending pod (reference :246 Add)."""
         with self._cond:
-            pi = PodInfo(pod, self._now())
-            self.active_q.add(pi)
-            self.unschedulable_q.pop(_pod_key(pod), None)
-            self.pod_backoff_q.delete_by_key(_pod_key(pod))
-            self.nominated_pods.add(pod, "")
+            self._add_locked(pod, self._now())
             self._cond.notify()
+
+    def add_many(self, pods: List[Pod]) -> None:
+        """Bulk add under one lock hold + one wakeup (a watch frame's
+        worth of new pending pods)."""
+        if not pods:
+            return
+        with self._cond:
+            now = self._now()
+            for pod in pods:
+                self._add_locked(pod, now)
+            self._cond.notify()
+
+    def delete_many(self, pods: List[Pod]) -> None:
+        """Bulk delete under one lock hold (bound-pod echo frames)."""
+        if not pods:
+            return
+        with self._cond:
+            for pod in pods:
+                self._delete_locked(pod)
 
     def add_unschedulable_if_not_present(
         self, pi: PodInfo, pod_scheduling_cycle: int
@@ -196,11 +225,7 @@ class PriorityQueue:
 
     def delete(self, pod: Pod) -> None:
         with self._cond:
-            key = _pod_key(pod)
-            self.nominated_pods.delete(pod)
-            self.active_q.delete_by_key(key)
-            self.pod_backoff_q.delete_by_key(key)
-            self.unschedulable_q.pop(key, None)
+            self._delete_locked(pod)
 
     # -- pop ----------------------------------------------------------------
 
@@ -329,6 +354,22 @@ class PriorityQueue:
         to backoff instead of parking unschedulable)."""
         self.move_pods_to_active_or_backoff_queue(
             self._pods_with_matching_affinity_term(pod), events.AssignedPodAdd
+        )
+
+    def assigned_pods_added_many(self, pods: List[Pod]) -> None:
+        """Frame variant of assigned_pod_added: one move request (one
+        lock hold, one move_request_cycle bump, one wakeup) covering the
+        union of affinity-matched parked pods."""
+        matched: List[PodInfo] = []
+        seen = set()
+        for pod in pods:
+            for pi in self._pods_with_matching_affinity_term(pod):
+                key = _info_key(pi)
+                if key not in seen:
+                    seen.add(key)
+                    matched.append(pi)
+        self.move_pods_to_active_or_backoff_queue(
+            matched, events.AssignedPodAdd
         )
 
     def assigned_pod_updated(self, pod: Pod) -> None:

@@ -63,12 +63,54 @@ def add_all_event_handlers(
             logger.exception("remove pod %s from cache", pod.key())
         sched.queue.move_all_to_active_or_backoff_queue(events.AssignedPodDelete)
 
+    def assigned_pods_batch(frame) -> None:
+        """Whole-frame bridge for assigned pods: the bind-echo burst
+        (thousands of MODIFIED events per frame during a 10k burst) is
+        confirmed into the cache under one lock and wakes affinity
+        matches with one move request. Only CONSECUTIVE adds coalesce --
+        any other transition flushes first, so per-pod event order within
+        the frame is preserved (an add+delete pair must not resurrect
+        the pod by deferring its add past its delete)."""
+        adds = []
+
+        def flush_adds() -> None:
+            if not adds:
+                return
+            try:
+                sched.cache.add_pods(adds)
+            except Exception:
+                logger.exception("bulk add pods to cache")
+            sched.queue.assigned_pods_added_many(adds)
+            adds.clear()
+
+        for etype, old, new in frame:
+            new_ok = _assigned(new)
+            old_ok = old is not None and _assigned(old)
+            if etype == "ADDED":
+                if new_ok:
+                    adds.append(new)
+            elif etype == "MODIFIED":
+                if old_ok and new_ok:
+                    flush_adds()
+                    update_pod_in_cache(old, new)
+                elif not old_ok and new_ok:
+                    adds.append(new)
+                elif old_ok and not new_ok:
+                    flush_adds()
+                    delete_pod_from_cache(old)
+            elif etype == "DELETED":
+                if new_ok:
+                    flush_adds()
+                    delete_pod_from_cache(new)
+        flush_adds()
+
     pods.add_event_handler(
         ResourceEventHandler(
             filter_func=_assigned,
             on_add=add_pod_to_cache,
             on_update=update_pod_in_cache,
             on_delete=delete_pod_from_cache,
+            on_batch=assigned_pods_batch,
         )
     )
 
@@ -100,6 +142,62 @@ def add_all_event_handlers(
         for fw in sched.profiles.values():
             fw.reject_waiting_pod(pod.metadata.uid)
 
+    def unassigned_pods_batch(frame) -> None:
+        """Whole-frame bridge for pending pods: CONSECUTIVE runs of
+        plain adds queue under one lock + one wakeup, consecutive runs of
+        queue-leaves (bound-pod echoes) leave in one bulk delete; every
+        other transition flushes both runs first so per-pod event order
+        within the frame is preserved. Gang-label adds keep the per-event
+        path (targeted sibling wakeups)."""
+        from kubernetes_tpu.api.types import POD_GROUP_LABEL
+
+        adds = []
+        deletes = []
+
+        def flush() -> None:
+            if adds:
+                sched.queue.add_many(adds)
+                adds.clear()
+            if deletes:
+                sched.queue.delete_many(deletes)
+                for pod in deletes:
+                    for fw in sched.profiles.values():
+                        fw.reject_waiting_pod(pod.metadata.uid)
+                deletes.clear()
+
+        for etype, old, new in frame:
+            new_ok = not _assigned(new) and _responsible_for_pod(sched, new)
+            old_ok = (
+                old is not None
+                and not _assigned(old)
+                and _responsible_for_pod(sched, old)
+            )
+            if etype == "ADDED":
+                if new_ok:
+                    if new.metadata.labels.get(POD_GROUP_LABEL):
+                        flush()
+                        add_pod_to_queue(new)  # gang sibling wakeups
+                    else:
+                        if deletes:
+                            flush()
+                        adds.append(new)
+            elif etype == "MODIFIED":
+                if old_ok and new_ok:
+                    flush()
+                    update_pod_in_queue(old, new)
+                elif not old_ok and new_ok:
+                    flush()
+                    add_pod_to_queue(new)
+                elif old_ok and not new_ok:
+                    if adds:
+                        flush()
+                    deletes.append(old)
+            elif etype == "DELETED":
+                if new_ok:
+                    flush()
+                    delete_pod_from_queue(new)
+        flush()
+
     pods.add_event_handler(
         ResourceEventHandler(
             filter_func=lambda p: not _assigned(p)
@@ -107,6 +205,7 @@ def add_all_event_handlers(
             on_add=add_pod_to_queue,
             on_update=update_pod_in_queue,
             on_delete=delete_pod_from_queue,
+            on_batch=unassigned_pods_batch,
         )
     )
 
