@@ -149,10 +149,18 @@ class SchedulerCache:
         """Bulk add/confirm under one lock hold (the watch-frame analogue
         of N add_pod calls); a duplicate add raises in add_pod but is
         skipped in bulk (the informer can legitimately replay an add
-        after a relist)."""
+        after a relist). Failures are isolated per pod -- one bad object
+        must not drop the rest of the frame from the cache."""
+        import logging
+
         with self._lock:
             for pod in pods:
-                self._add_pod_locked(pod, strict=False)
+                try:
+                    self._add_pod_locked(pod, strict=False)
+                except Exception:
+                    logging.getLogger(__name__).exception(
+                        "bulk add of pod %s", pod.key()
+                    )
 
     def update_pod(self, old: Pod, new: Pod) -> None:
         with self._lock:

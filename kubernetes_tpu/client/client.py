@@ -75,6 +75,14 @@ class Client:
     def list_pdbs(self) -> Tuple[List[PodDisruptionBudget], int]:
         return self._server.list("PodDisruptionBudget")
 
+    def update_pdb_status(
+        self, namespace: str, name: str, mutate
+    ) -> PodDisruptionBudget:
+        """pdb/status subresource (the disruption controller's write)."""
+        return self._server.guaranteed_update(
+            "PodDisruptionBudget", namespace, name, mutate
+        )
+
     def create_pod_group(self, pg: PodGroup) -> PodGroup:
         return self._server.create(pg)
 

@@ -1,0 +1,12 @@
+"""Controllers: the reconcile loops the scheduler depends on.
+
+Reference: /root/reference/cmd/kube-controller-manager/app/
+controllermanager.go:372 (controller list); only the loops with
+scheduler-facing outputs are built here -- the disruption controller
+maintains PDB.Status.DisruptionsAllowed, the budget preemption spends
+(generic_scheduler.go:885-887).
+"""
+
+from kubernetes_tpu.controllers.disruption import DisruptionController
+
+__all__ = ["DisruptionController"]

@@ -32,7 +32,12 @@ import jax
 import jax.numpy as jnp
 
 from kubernetes_tpu.api.types import Binding, Pod
-from kubernetes_tpu.framework.interface import CycleState, FitError, PodInfo
+from kubernetes_tpu.framework.interface import (
+    CycleState,
+    FitError,
+    PodInfo,
+    Status,
+)
 from kubernetes_tpu.ops.assignment import (
     GreedyConfig,
     NO_NODE,
@@ -631,6 +636,8 @@ class BatchScheduler(Scheduler):
                 "cycle": pod_scheduling_cycle,
                 "overlaid": overlaid,
                 "solve_timer": solve_timer,
+                "mask_rows": mask_rows,
+                "mask_index_solved": midx,
             }
 
         # one batched host->device transfer for everything we must upload
@@ -742,6 +749,8 @@ class BatchScheduler(Scheduler):
             "cycle": pod_scheduling_cycle,
             "overlaid": overlaid,
             "solve_timer": solve_timer,
+            "mask_rows": mask_rows,
+            "mask_index_solved": midx,
         }
 
     def _complete_solve(self, p) -> None:
@@ -769,6 +778,7 @@ class BatchScheduler(Scheduler):
         self._commit_batch(
             p["solver_infos"], p["order"], assignments, p["names"],
             p["num_nodes"], p["snapshot"], p["cycle"],
+            mask_info=(p.get("mask_rows"), p.get("mask_index_solved")),
         )
 
     # -- batched commit ------------------------------------------------------
@@ -782,6 +792,7 @@ class BatchScheduler(Scheduler):
         num_nodes: int,
         snapshot,
         pod_scheduling_cycle: int,
+        mask_info=None,
     ) -> None:
         """Post-solve pipeline for the whole batch: Reserve -> assume ->
         Permit (scheduler.go:615-660 semantics preserved), then ONE async
@@ -808,12 +819,12 @@ class BatchScheduler(Scheduler):
         )
 
         plain: List[Tuple[PodInfo, str]] = []  # (pod_info, host)
-        slow: List[Tuple[PodInfo, int]] = []  # (pod_info, choice)
+        slow: List[Tuple[PodInfo, int, int]] = []  # (pod_info, choice, k)
         for k in range(b):
             pi = solver_infos[int(order[k])]
             choice = int(assignments[k])
             if choice == NO_NODE:
-                slow.append((pi, choice))
+                slow.append((pi, choice, k))
                 continue
             pod = pi.pod
             if (
@@ -826,7 +837,7 @@ class BatchScheduler(Scheduler):
             ):
                 plain.append((pi, names[choice]))
             else:
-                slow.append((pi, choice))
+                slow.append((pi, choice, k))
 
         bulk: List[Tuple] = []
         if plain:
@@ -851,20 +862,66 @@ class BatchScheduler(Scheduler):
                 bulk.append((prof, state, pi, assumed, host))
             self.pods_solved_on_device += len(plain)
 
-        for pi, choice in slow:
+        failed_group: List[Tuple[PodInfo, FitError]] = []
+        cluster_anti = None
+        # statuses are a pure function of the (deduplicated) mask row:
+        # identical unschedulable pods share one dict
+        statuses_by_row: dict = {}
+        for pi, choice, k in slow:
             state = CycleState()
             state.write(SNAPSHOT_STATE_KEY, snapshot)
             if choice == NO_NODE:
                 metrics.schedule_attempts.inc(result="unschedulable")
-                # populate PreFilter state so preemption's victim
+                # per-node reason codes (SURVEY section 7 hardest-part d,
+                # generic_scheduler.go:1033): nodes rejected by the
+                # STATIC mask (label/taint/name/unschedulable mismatch)
+                # can never be helped by preemption -- mark them
+                # UnschedulableAndUnresolvable so
+                # nodes_where_preemption_might_help prunes like the
+                # reference instead of scanning every node
+                statuses = {}
+                if mask_info is not None and mask_info[0] is not None:
+                    m_rows, m_idx = mask_info
+                    ridx = int(m_idx[k])
+                    statuses = statuses_by_row.get(ridx)
+                    if statuses is None:
+                        statuses = {
+                            names[int(j)]:
+                            Status.unschedulable_and_unresolvable(
+                                "node(s) didn't match the static "
+                                "feasibility mask"
+                            )
+                            for j in np.flatnonzero(
+                                ~m_rows[ridx][:num_nodes]
+                            )
+                        }
+                        statuses_by_row[ridx] = statuses
+                fit_err = FitError(pi.pod, num_nodes, statuses)
+                self.pods_solved_on_device += 1
+                # device-eligible failures preempt as ONE group (one
+                # device round trip via Preemptor.preempt_batch); the
+                # rest take the per-pod host path
+                if self.preemptor is not None:
+                    if cluster_anti is None:
+                        from kubernetes_tpu.ops.affinity import (
+                            cluster_has_required_anti_affinity,
+                        )
+
+                        cluster_anti = cluster_has_required_anti_affinity(
+                            snapshot
+                        )
+                    if self.preemptor.device_eligible(
+                        prof, pi.pod, cluster_anti=cluster_anti
+                    ):
+                        failed_group.append((pi, fit_err))
+                        continue
+                # populate PreFilter state so host preemption's victim
                 # simulation can run the full filter pipeline (the
                 # sequential path gets this from algorithm.schedule)
                 prof.run_pre_filter_plugins(state, pi.pod)
-                fit_err = FitError(pi.pod, num_nodes, {})
                 self.handle_fit_error(
                     prof, state, pi, fit_err, pod_scheduling_cycle
                 )
-                self.pods_solved_on_device += 1
                 continue
             host = names[choice]
             assumed = self.reserve_assume_permit(
@@ -898,6 +955,19 @@ class BatchScheduler(Scheduler):
                     )
             else:
                 bulk.append((prof, state, pi, assumed, host))
+        if failed_group:
+            try:
+                nominated = self.preemptor.preempt_batch(
+                    prof, [(pi.pod, fe) for pi, fe in failed_group]
+                )
+            except Exception:
+                logger.exception("batched device preemption failed")
+                nominated = [""] * len(failed_group)
+            for (pi, fe), node in zip(failed_group, nominated):
+                self.record_scheduling_failure(
+                    prof, pi, str(fe), "Unschedulable", node,
+                    pod_scheduling_cycle,
+                )
         if bulk:
             with self._inflight_lock:
                 self._inflight_binds += 1

@@ -229,6 +229,42 @@ def add_all_event_handlers(
         )
     )
 
+    # storage + service wakeups (eventhandlers.go:415-460): each mutation
+    # can unblock pods parked on the corresponding filter family, so move
+    # the unschedulable queue with the matching typed event
+    def _wake(event):
+        def on_one(*_args) -> None:
+            sched.queue.move_all_to_active_or_backoff_queue(event)
+
+        return on_one
+
+    informer_factory.persistent_volumes().add_event_handler(
+        ResourceEventHandler(
+            on_add=_wake(events.PvAdd), on_update=_wake(events.PvUpdate)
+        )
+    )
+    informer_factory.persistent_volume_claims().add_event_handler(
+        ResourceEventHandler(
+            on_add=_wake(events.PvcAdd), on_update=_wake(events.PvcUpdate)
+        )
+    )
+    informer_factory.services().add_event_handler(
+        ResourceEventHandler(
+            on_add=_wake(events.ServiceAdd),
+            on_update=_wake(events.ServiceUpdate),
+            on_delete=_wake(events.ServiceDelete),
+        )
+    )
+    informer_factory.storage_classes().add_event_handler(
+        ResourceEventHandler(on_add=_wake(events.StorageClassAdd))
+    )
+    informer_factory.csi_nodes().add_event_handler(
+        ResourceEventHandler(
+            on_add=_wake(events.CSINodeAdd),
+            on_update=_wake(events.CSINodeUpdate),
+        )
+    )
+
 
 def _node_scheduling_properties_changed(old: Node, new: Node) -> str:
     """eventhandlers.go:445 nodeSchedulingPropertiesChange: only wake

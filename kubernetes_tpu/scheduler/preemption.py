@@ -142,10 +142,31 @@ class Preemptor:
     """Wires the preemption algorithm to the API side effects
     (scheduler.go:392 preempt + podPreemptor)."""
 
+    #: filter plugins whose semantics the device victim search models
+    #: exactly for a plain (solver_supported) preemptor: resource fit +
+    #: the static label mask, plus plugins that are no-ops for pods
+    #: without the matching spec fields (ports/volumes/spread/affinity)
+    DEVICE_MODELED_FILTERS = frozenset({
+        "NodeUnschedulable", "NodeResourcesFit", "NodeName", "NodePorts",
+        "NodeAffinity", "VolumeRestrictions", "TaintToleration",
+        "EBSLimits", "GCEPDLimits", "AzureDiskLimits",
+        "NodeVolumeLimitsCSI", "VolumeBinding", "VolumeZone",
+        "PodTopologySpread", "InterPodAffinity",
+    })
+
     def __init__(self, algorithm, queue, client) -> None:
         self.algorithm = algorithm  # GenericScheduler (snapshot + filters)
         self.queue = queue
         self.client = client
+        # device victim-search state (stage-7): tensors cached per
+        # snapshot generation so a burst of failed pods packs once
+        from kubernetes_tpu.tensors import NodeTensorCache
+
+        self._tensor_cache = NodeTensorCache()
+        self._pack = None
+        self._pack_key = None
+        self.device_preemptions = 0
+        self.host_preemptions = 0
 
     # -- eligibility --------------------------------------------------------
 
@@ -239,6 +260,157 @@ class Preemptor:
             reprieve(p)
         return victims, num_violating, True
 
+    def device_eligible(self, prof, pod: Pod, cluster_anti=None) -> bool:
+        """True when the device victim search is exact for this pod:
+        plain pod (solver_supported), no gang semantics, no extenders,
+        no custom filter plugins, and no existing-pod required
+        anti-affinity (whose removal the device fit model can't see).
+        ``cluster_anti`` may carry a precomputed
+        cluster_has_required_anti_affinity answer (the batch path checks
+        eligibility for hundreds of pods against one snapshot)."""
+        from kubernetes_tpu.api.types import POD_GROUP_LABEL
+        from kubernetes_tpu.ops.affinity import (
+            cluster_has_required_anti_affinity,
+        )
+        from kubernetes_tpu.scheduler.batch import solver_supported
+
+        if not solver_supported(pod):
+            return False
+        # solver_supported admits required pod (anti-)affinity and hard
+        # spread (the batch solver models them via count tensors); the
+        # victim search does NOT -- a preemptor carrying either must take
+        # the host oracle or it would evict victims for a node its
+        # constraint still rejects
+        if pod.spec.topology_spread_constraints:
+            return False
+        a = pod.spec.affinity
+        if a is not None and (
+            a.pod_affinity is not None or a.pod_anti_affinity is not None
+        ):
+            return False
+        if pod.metadata.labels.get(POD_GROUP_LABEL):
+            return False
+        if getattr(self.algorithm, "extenders", []):
+            return False
+        filters = set(prof.list_plugins().get("filter", []))
+        if not filters <= self.DEVICE_MODELED_FILTERS:
+            return False
+        if cluster_anti is None:
+            cluster_anti = cluster_has_required_anti_affinity(
+                self.algorithm.snapshot
+            )
+        if cluster_anti:
+            return False
+        return True
+
+    def _device_answers(
+        self, pods: List[Pod], potentials, pdbs
+    ) -> List[Tuple[str, List[Pod], int]]:
+        """Stage-7 device victim search (ops/preemption.py) for a group
+        of failed pods in priority-desc order, ONE device round trip: the
+        kernel's pod scan carries each nomination so later pods see
+        earlier ones (addNominatedPods semantics). Returns one
+        (node_name, victims, num_violating) per pod ("" = no candidate).
+
+        ``potentials``: per-pod iterable of candidate NodeInfos (already
+        pruned of UnschedulableAndUnresolvable nodes)."""
+        import numpy as np
+
+        from kubernetes_tpu.ops.host_masks import static_mask_compact
+        from kubernetes_tpu.ops.preemption import (
+            pack_preemption_state,
+            preempt_batch_device,
+            victims_for_node,
+        )
+        from kubernetes_tpu.tensors import pack_pod_batch
+
+        snapshot = self.algorithm.snapshot
+        nt = self._tensor_cache.update(snapshot)
+        key = (
+            snapshot.generation,
+            tuple(
+                (
+                    pdb.metadata.namespace, pdb.metadata.name,
+                    pdb.metadata.resource_version,
+                    pdb.status.disruptions_allowed,
+                )
+                for pdb in pdbs
+            ),
+        )
+        if self._pack is None or self._pack_key != key:
+            self._pack = pack_preemption_state(snapshot, nt, pdbs)
+            self._pack_key = key
+        pack = self._pack
+        n = len(pack.node_names)
+        b = len(pods)
+
+        batch = pack_pod_batch(pods, nt.dims)
+        mask_rows, mask_index = static_mask_compact(pods, snapshot, nt)
+        candidate = np.zeros((b, n), dtype=bool)
+        nt_rows = np.array(
+            [nt.row(name) for name in pack.node_names], dtype=np.int64
+        )
+        for k, pod in enumerate(pods):
+            if batch.unsatisfiable[k]:
+                continue  # no pod removal adds a resource dimension
+            row = mask_rows[mask_index[k]][nt_rows]
+            potential_names = {ni.node_name for ni in potentials[k]}
+            candidate[k] = row & np.array(
+                [name in potential_names for name in pack.node_names]
+            )
+
+        # pre-existing nominations (in-scan ones ride the kernel carry)
+        pod_uids = {p.metadata.uid for p in pods}
+        nom_pods, nom_prio, nom_node = [], [], []
+        for node_name, noms in (
+            self.queue.all_nominated_pods_by_node() if self.queue else {}
+        ).items():
+            i = pack.node_index.get(node_name)
+            if i is None:
+                continue
+            for p in noms:
+                if p.metadata.uid in pod_uids:
+                    continue
+                nom_pods.append(p)
+                nom_prio.append(p.spec.priority)
+                nom_node.append(i)
+        if nom_pods:
+            nom_req = pack_pod_batch(nom_pods, nt.dims).requests
+        else:
+            nom_req = np.zeros((0, nt.dims.num_dims), dtype=np.int32)
+
+        chosen, victims, viol, nviol = preempt_batch_device(
+            pack,
+            batch.requests,
+            np.clip(
+                [p.spec.priority for p in pods], -(1 << 31), (1 << 31) - 2
+            ).astype(np.int32),
+            candidate,
+            nom_req,
+            np.array(nom_prio, dtype=np.int32),
+            np.array(nom_node, dtype=np.int32),
+        )
+        out = []
+        for k in range(b):
+            idx = int(chosen[k])
+            if idx < 0:
+                out.append(("", [], 0))
+                continue
+            out.append(
+                (
+                    pack.node_names[idx],
+                    victims_for_node(pack, idx, victims[k], viol[k]),
+                    int(nviol[k]),
+                )
+            )
+        return out
+
+    def _find_preemption_device(
+        self, pod: Pod, potential, pdbs
+    ) -> Optional[Tuple[str, List[Pod], int]]:
+        """Single-pod wrapper over the batched device search."""
+        return self._device_answers([pod], [potential], pdbs)[0]
+
     def find_preemption(
         self, prof, state: CycleState, pod: Pod, fit_err: FitError
     ) -> Tuple[str, List[Pod], List[Pod]]:
@@ -255,6 +427,18 @@ class Preemptor:
                 pdbs, _ = self.client.list_pdbs()
             except Exception:
                 logger.exception("listing PDBs")
+        if self.device_eligible(prof, pod):
+            result = self._find_preemption_device(pod, potential, pdbs)
+            if result is not None:
+                self.device_preemptions += 1
+                node_name, victims, _ = result
+                if not node_name:
+                    return "", [], []
+                nominated_to_clear = self._lower_priority_nominated_pods(
+                    pod, node_name
+                )
+                return node_name, victims, nominated_to_clear
+        self.host_preemptions += 1
         nodes_to_victims: Dict[str, Victims] = {}
         for ni in potential:
             victims, num_violating, fits = self.select_victims_on_node(
@@ -287,6 +471,121 @@ class Preemptor:
         nominated = self.queue.nominated_pods_for_node(node_name)
         return [p for p in nominated if p.spec.priority < pod.spec.priority]
 
+    # -- batched entry (the BatchScheduler's NO_NODE group) ------------------
+
+    def preempt_batch(
+        self, prof, items: List[Tuple[Pod, FitError]]
+    ) -> List[str]:
+        """Preemption for a whole failed-pod group (priority-desc order)
+        in ONE device round trip, then the per-pod API side effects in
+        order. Every pod must already be device_eligible. Returns the
+        nominated node name per pod ("" = none)."""
+        pods = []
+        for pod, _ in items:
+            if self.client is not None:
+                try:
+                    pod = self.client.get_pod(
+                        pod.metadata.namespace, pod.metadata.name
+                    )
+                except KeyError:
+                    pod = None
+            pods.append(pod)
+        pdbs = []
+        if self.client is not None:
+            try:
+                pdbs, _ = self.client.list_pdbs()
+            except Exception:
+                logger.exception("listing PDBs")
+        live: List[int] = []
+        live_pods: List[Pod] = []
+        potentials = []
+        results = [""] * len(items)
+        for k, (item, pod) in enumerate(zip(items, pods)):
+            if pod is None or not self.pod_eligible_to_preempt_others(pod):
+                continue
+            potential = self.nodes_where_preemption_might_help(item[1])
+            if not potential:
+                # no node can ever help: clear any stale nomination (the
+                # host path's to_clear=[pod] branch)
+                metrics.preemption_attempts.inc()
+                self._clear_nomination(pod)
+                continue
+            live.append(k)
+            live_pods.append(pod)
+            potentials.append(potential)
+        if not live_pods:
+            return results
+        answers = self._device_answers(live_pods, potentials, pdbs)
+        self.device_preemptions += len(live_pods)
+        for k, pod, (node_name, victims, _) in zip(
+            live, live_pods, answers
+        ):
+            metrics.preemption_attempts.inc()
+            if node_name:
+                metrics.preemption_victims.observe(len(victims))
+                if self._apply_preemption(prof, pod, node_name, victims):
+                    results[k] = node_name
+        return results
+
+    def _clear_nomination(self, pod: Pod) -> None:
+        self.queue.delete_nominated_pod_if_exists(pod)
+        if self.client is not None and pod.status.nominated_node_name:
+            try:
+                def clear(q: Pod) -> None:
+                    q.status.nominated_node_name = ""
+
+                self.client.update_pod_status(
+                    pod.metadata.namespace, pod.metadata.name, clear
+                )
+            except Exception:
+                logger.exception("clearing nominatedNodeName")
+
+    def _apply_preemption(
+        self, prof, pod: Pod, node_name: str, victims: List[Pod]
+    ) -> bool:
+        """The API side effects of one successful preemption
+        (scheduler.go:392): nominate, delete victims, clear superseded
+        lower-priority nominations. Returns False when the nomination
+        write failed and was rolled back (no victims were evicted) --
+        callers must then report no nomination."""
+        self.queue.update_nominated_pod_for_node(pod, node_name)
+        if self.client is not None:
+            try:
+                def set_nominated(p: Pod) -> None:
+                    p.status.nominated_node_name = node_name
+
+                self.client.update_pod_status(
+                    pod.metadata.namespace, pod.metadata.name, set_nominated
+                )
+            except Exception:
+                logger.exception("setting nominatedNodeName")
+                self.queue.delete_nominated_pod_if_exists(pod)
+                return False
+        for victim in victims:
+            if self.client is not None:
+                try:
+                    self.client.delete_pod(
+                        victim.metadata.namespace, victim.metadata.name
+                    )
+                except KeyError:
+                    pass
+            waiting = prof.get_waiting_pod(victim.metadata.uid)
+            if waiting is not None:
+                waiting.reject("preemption", "preempted")
+        for p in self._lower_priority_nominated_pods(pod, node_name):
+            self.queue.delete_nominated_pod_if_exists(p)
+            if self.client is not None and p.status.nominated_node_name:
+                try:
+                    def clear(q: Pod) -> None:
+                        q.status.nominated_node_name = ""
+
+                    self.client.update_pod_status(
+                        p.metadata.namespace, p.metadata.name, clear
+                    )
+                except Exception:
+                    logger.exception("clearing nominatedNodeName")
+        return True
+
     # -- host-side actions (scheduler.go:392) --------------------------------
 
     def preempt(
@@ -305,40 +604,10 @@ class Preemptor:
         metrics.preemption_attempts.inc()
         if node_name:
             metrics.preemption_victims.observe(len(victims))
-            self.queue.update_nominated_pod_for_node(pod, node_name)
-            if self.client is not None:
-                try:
-                    def set_nominated(p: Pod) -> None:
-                        p.status.nominated_node_name = node_name
-
-                    self.client.update_pod_status(
-                        pod.metadata.namespace, pod.metadata.name, set_nominated
-                    )
-                except Exception:
-                    logger.exception("setting nominatedNodeName")
-                    self.queue.delete_nominated_pod_if_exists(pod)
-                    return ""
-            for victim in victims:
-                if self.client is not None:
-                    try:
-                        self.client.delete_pod(
-                            victim.metadata.namespace, victim.metadata.name
-                        )
-                    except KeyError:
-                        pass
-                waiting = prof.get_waiting_pod(victim.metadata.uid)
-                if waiting is not None:
-                    waiting.reject("preemption", "preempted")
+            if not self._apply_preemption(prof, pod, node_name, victims):
+                return ""  # nomination write failed and was rolled back
+            return node_name
+        # no candidate: clear any stale nomination of the pod itself
         for p in to_clear:
-            self.queue.delete_nominated_pod_if_exists(p)
-            if self.client is not None and p.status.nominated_node_name:
-                try:
-                    def clear(q: Pod) -> None:
-                        q.status.nominated_node_name = ""
-
-                    self.client.update_pod_status(
-                        p.metadata.namespace, p.metadata.name, clear
-                    )
-                except Exception:
-                    logger.exception("clearing nominatedNodeName")
+            self._clear_nomination(p)
         return node_name

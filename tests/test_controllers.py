@@ -1,0 +1,202 @@
+"""Disruption controller + storage/service queue wakeups.
+
+Reference: pkg/controller/disruption/disruption.go (DisruptionsAllowed
+reconcile) and pkg/scheduler/eventhandlers.go:415-460 (PV/PVC/Service/
+StorageClass/CSINode informer handlers -> queue moves).
+"""
+
+import time
+
+from kubernetes_tpu.api.types import (
+    LabelSelector,
+    ObjectMeta,
+    PersistentVolume,
+    PodDisruptionBudget,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.controllers import DisruptionController
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _pdb(name, match, min_available=None, max_unavailable=None):
+    pdb = PodDisruptionBudget(
+        selector=LabelSelector(match_labels=match),
+        min_available=min_available,
+        max_unavailable=max_unavailable,
+    )
+    pdb.metadata.name = name
+    pdb.metadata.namespace = "default"
+    return pdb
+
+
+class TestDisruptionController:
+    def _env(self):
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        ctrl = DisruptionController(client, informers)
+        return server, client, informers, ctrl
+
+    def test_min_available(self):
+        server, client, informers, ctrl = self._env()
+        client.create_pdb(_pdb("a", {"app": "web"}, min_available=2))
+        for i in range(3):
+            client.create_pod(
+                make_pod(f"p{i}").labels(app="web").node("n1").obj()
+            )
+        informers.pods().pump()
+        informers.pdbs().pump()
+        ctrl.sync_all()
+        pdbs, _ = client.list_pdbs()
+        assert pdbs[0].status.disruptions_allowed == 1  # 3 healthy - 2
+
+    def test_max_unavailable(self):
+        server, client, informers, ctrl = self._env()
+        client.create_pdb(_pdb("a", {"app": "db"}, max_unavailable=1))
+        for i in range(4):
+            client.create_pod(
+                make_pod(f"p{i}").labels(app="db").node("n1").obj()
+            )
+        informers.pods().pump()
+        informers.pdbs().pump()
+        ctrl.sync_all()
+        pdbs, _ = client.list_pdbs()
+        # expected 4, desired 3, healthy 4 -> 1 disruption allowed
+        assert pdbs[0].status.disruptions_allowed == 1
+
+    def test_unbound_pods_not_healthy(self):
+        server, client, informers, ctrl = self._env()
+        client.create_pdb(_pdb("a", {"app": "web"}, min_available=1))
+        client.create_pod(make_pod("bound").labels(app="web").node("n").obj())
+        client.create_pod(make_pod("pending").labels(app="web").obj())
+        informers.pods().pump()
+        informers.pdbs().pump()
+        ctrl.sync_all()
+        pdbs, _ = client.list_pdbs()
+        assert pdbs[0].status.disruptions_allowed == 0  # 1 healthy - 1
+
+    def test_event_driven_loop(self):
+        server, client, informers, ctrl = self._env()
+        client.create_pdb(_pdb("a", {"app": "web"}, min_available=1))
+        informers.start()
+        informers.wait_for_cache_sync()
+        ctrl.start()
+        for i in range(3):
+            client.create_pod(
+                make_pod(f"p{i}").labels(app="web").node("n1").obj()
+            )
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            pdbs, _ = client.list_pdbs()
+            if pdbs[0].status.disruptions_allowed == 2:
+                break
+            time.sleep(0.02)
+        ctrl.stop()
+        informers.stop()
+        assert pdbs[0].status.disruptions_allowed == 2
+
+
+class TestPdbPreemptionEndToEnd:
+    def test_preemption_respects_controller_maintained_budget(self):
+        """PDB-aware preemption works WITHOUT test-injected status: the
+        controller computes DisruptionsAllowed and the preemptor prefers
+        non-violating victims (generic_scheduler.go:885-887)."""
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(client, informers, batch=True, max_batch=16)
+        ctrl = DisruptionController(client, informers)
+        # two nodes, each full with one low-priority pod; the protected
+        # one (PDB budget 0) must be reprieved, the other evicted
+        for n in ("n0", "n1"):
+            client.create_node(
+                make_node(n).capacity(cpu="2", memory="4Gi").obj()
+            )
+        client.create_pdb(_pdb("guard", {"app": "protected"}, min_available=1))
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        client.create_pod(
+            make_pod("prot").labels(app="protected").container(cpu="2")
+            .priority(0).obj()
+        )
+        client.create_pod(
+            make_pod("loose").labels(app="loose").container(cpu="2")
+            .priority(0).obj()
+        )
+        t = sched.start()
+        ctrl.start()
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            pods, _ = client.list_pods()
+            if sum(1 for p in pods if p.spec.node_name) >= 2:
+                break
+            time.sleep(0.02)
+        # budget settles at 0 (1 healthy - 1 minAvailable)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            pdbs, _ = client.list_pdbs()
+            if pdbs[0].status.disruptions_allowed == 0:
+                break
+            time.sleep(0.02)
+        client.create_pod(
+            make_pod("high").container(cpu="2").priority(100).obj()
+        )
+        deadline = time.time() + 15
+        bound_node = ""
+        while time.time() < deadline:
+            try:
+                p = client.get_pod("default", "high")
+            except KeyError:
+                break
+            if p.spec.node_name:
+                bound_node = p.spec.node_name
+                break
+            time.sleep(0.02)
+        sched.stop()
+        ctrl.stop()
+        informers.stop()
+        assert bound_node, "high-priority pod never bound"
+        # the protected pod survived; the loose one was evicted
+        pods, _ = client.list_pods()
+        names = {p.metadata.name for p in pods}
+        assert "prot" in names
+        assert "loose" not in names
+
+
+class TestStorageWakeups:
+    def test_pv_add_wakes_parked_pod(self):
+        """A pod parked on a missing PVC moves out of unschedulableQ
+        when a PV lands (eventhandlers.go:415 PvAdd)."""
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(client, informers, batch=True, max_batch=16)
+        client.create_node(make_node("n").capacity(cpu="4", memory="8Gi").obj())
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        client.create_pod(
+            make_pod("p").container(cpu="1").pvc("missing-claim").obj()
+        )
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            sched.schedule_batch(timeout=0.2)
+            if sched.queue.num_pending()["unschedulable"] == 1:
+                break
+        assert sched.queue.num_pending()["unschedulable"] == 1
+        pv = PersistentVolume(metadata=ObjectMeta(name="pv0", namespace=""))
+        server.create(pv)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            counts = sched.queue.num_pending()
+            if counts["unschedulable"] == 0:
+                break
+            time.sleep(0.02)
+        sched.stop()
+        informers.stop()
+        assert counts["unschedulable"] == 0
+        assert counts["active"] + counts["backoff"] == 1

@@ -1,0 +1,294 @@
+"""Device preemption (stage 7): randomized differential tests of the
+vectorized victim search (ops/preemption.py) against the host oracle
+(scheduler/preemption.py select_victims_on_node +
+pick_one_node_for_preemption), plus the batch path's per-node reason
+codes and an end-to-end preemption run through the BatchScheduler.
+
+Reference: generic_scheduler.go:850 selectNodesForPreemption,
+:940 selectVictimsOnNode, :721 pickOneNodeForPreemption,
+:884 filterPodsWithPDBViolation, :1033 nodesWherePreemptionMightHelp.
+"""
+
+import random
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import LabelSelector, PodDisruptionBudget
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.cache.cache import SchedulerCache
+from kubernetes_tpu.cache.snapshot import Snapshot
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.framework.interface import CycleState, FitError, StatusCode
+from kubernetes_tpu.framework.runtime import Framework
+from kubernetes_tpu.plugins import new_in_tree_registry
+from kubernetes_tpu.scheduler.generic import GenericScheduler
+from kubernetes_tpu.scheduler.preemption import (
+    Preemptor,
+    Victims,
+    pick_one_node_for_preemption,
+)
+from kubernetes_tpu.scheduler.provider import default_plugins
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _env(pods, nodes):
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    for p in pods:
+        cache.add_pod(p)
+    snapshot = Snapshot()
+    cache.update_snapshot(snapshot)
+    algorithm = GenericScheduler(cache, snapshot)
+    fw = Framework(
+        new_in_tree_registry(),
+        default_plugins(),
+        snapshot_provider=lambda: snapshot,
+    )
+    return algorithm, fw
+
+
+def _fail(algorithm, fw, pod):
+    state = CycleState()
+    with pytest.raises(FitError) as exc:
+        algorithm.schedule(fw, state, pod)
+    return state, exc.value
+
+
+def _random_cluster(rng, with_pdbs):
+    nodes = []
+    for i in range(16):
+        w = make_node(f"n{i}").capacity(
+            cpu=str(rng.choice([2, 4, 8])), memory="16Gi", pods=32
+        )
+        if rng.random() < 0.2:
+            w.label("disk", "ssd")
+        if rng.random() < 0.15:
+            w.taint("dedicated", "infra")
+        nodes.append(w.obj())
+    pods = []
+    t0 = time.time() - 10_000
+    # near-fill every node so the preemptor always needs victims
+    for i, n in enumerate(nodes):
+        cap_milli = n.status.allocatable["cpu"]
+        p = (
+            make_pod(f"fill{i}")
+            .node(n.metadata.name)
+            .container(cpu=f"{cap_milli - 1000}m", memory="8Gi")
+            .labels(app=rng.choice(["a", "b", "c"]))
+            .priority(rng.choice([0, 5]))
+            .obj()
+        )
+        p.status.start_time = t0 + rng.randrange(10_000)
+        pods.append(p)
+    for j in range(40):
+        node = f"n{rng.randrange(16)}"
+        p = (
+            make_pod(f"p{j}")
+            .node(node)
+            .container(
+                cpu=f"{rng.choice([250, 500, 1000, 2000])}m",
+                memory=f"{rng.choice([128, 512, 1024])}Mi",
+            )
+            .labels(app=rng.choice(["a", "b", "c"]))
+            .priority(rng.choice([0, 0, 5, 10, 50]))
+            .obj()
+        )
+        p.status.start_time = t0 + rng.randrange(10_000)
+        pods.append(p)
+    pdbs = []
+    if with_pdbs:
+        for app, budget in (("a", 1), ("b", 0)):
+            pdbs.append(
+                PodDisruptionBudget(
+                    selector=LabelSelector(match_labels={"app": app}),
+                )
+            )
+            pdbs[-1].status.disruptions_allowed = budget
+            pdbs[-1].metadata.name = f"pdb-{app}"
+            pdbs[-1].metadata.namespace = "default"
+    return nodes, pods, pdbs
+
+
+def _host_answer(preemptor, prof, state, pod, fit_err, pdbs):
+    """The oracle: per-node select_victims + 6-rule pick."""
+    potential = preemptor.nodes_where_preemption_might_help(fit_err)
+    nodes_to_victims = {}
+    for ni in potential:
+        victims, num_violating, fits = preemptor.select_victims_on_node(
+            prof, state, pod, ni, pdbs
+        )
+        if fits:
+            nodes_to_victims[ni.node_name] = Victims(victims, num_violating)
+    node = pick_one_node_for_preemption(nodes_to_victims)
+    if node is None:
+        return "", set()
+    return node, {p.metadata.name for p in nodes_to_victims[node].pods}
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("with_pdbs", [False, True])
+def test_device_matches_host_oracle(seed, with_pdbs):
+    rng = random.Random(seed)
+    nodes, pods, pdbs = _random_cluster(rng, with_pdbs)
+    algorithm, fw = _env(pods, nodes)
+    preemptor = Preemptor(algorithm, None, None)
+
+    # a preemptor big enough to need victims somewhere
+    preemptor_pod = (
+        make_pod("preemptor")
+        .container(cpu="2", memory="4Gi")
+        .priority(100)
+        .obj()
+    )
+    if rng.random() < 0.5:
+        preemptor_pod.spec.node_selector["disk"] = "ssd"
+    state, fit_err = _fail(algorithm, fw, preemptor_pod)
+
+    assert preemptor.device_eligible(fw, preemptor_pod)
+    dev = preemptor._find_preemption_device(
+        preemptor_pod,
+        preemptor.nodes_where_preemption_might_help(fit_err),
+        pdbs,
+    )
+    assert dev is not None
+    dev_node, dev_victims, _ = dev
+    host_node, host_victims = _host_answer(
+        preemptor, fw, state, preemptor_pod, fit_err, pdbs
+    )
+    assert dev_node == host_node
+    assert {p.metadata.name for p in dev_victims} == host_victims
+
+
+def test_pdb_budget_ordering_matches_oracle():
+    """Victims protected by an exhausted PDB go violating-first through
+    reprieve, matching filterPodsWithPDBViolation + the reprieve order."""
+    rng = random.Random(99)
+    nodes, pods, pdbs = _random_cluster(rng, True)
+    # park every pod on one node so PDB budgets really contend
+    for p in pods[:20]:
+        p.spec.node_name = "n0"
+    nodes[0].status.allocatable["cpu"] = 64000
+    nodes[0].status.capacity["cpu"] = 64000
+    nodes[0].status.allocatable["memory"] = 128 * 1024**3
+    algorithm, fw = _env(pods, nodes)
+    preemptor = Preemptor(algorithm, None, None)
+    preemptor_pod = (
+        make_pod("preemptor").container(cpu="60", memory="100Gi")
+        .priority(100).obj()
+    )
+    state, fit_err = _fail(algorithm, fw, preemptor_pod)
+    dev = preemptor._find_preemption_device(
+        preemptor_pod,
+        preemptor.nodes_where_preemption_might_help(fit_err),
+        pdbs,
+    )
+    host_node, host_victims = _host_answer(
+        preemptor, fw, state, preemptor_pod, fit_err, pdbs
+    )
+    assert dev is not None
+    assert dev[0] == host_node
+    assert {p.metadata.name for p in dev[1]} == host_victims
+
+
+def test_batch_path_emits_static_mask_reason_codes():
+    """A device-solved NO_NODE pod's FitError carries
+    UnschedulableAndUnresolvable for statically masked nodes
+    (generic_scheduler.go:1033 pruning input)."""
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(client, informers, batch=True, max_batch=16)
+    client.create_node(
+        make_node("match").capacity(cpu="1", memory="1Gi").label("disk", "ssd").obj()
+    )
+    client.create_node(
+        make_node("nomatch").capacity(cpu="8", memory="16Gi").obj()
+    )
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+
+    captured = []
+    orig = sched.handle_fit_error
+
+    def capture(prof, state, pi, fit_err, cycle):
+        captured.append(fit_err)
+        return orig(prof, state, pi, fit_err, cycle)
+
+    sched.handle_fit_error = capture
+    orig_pb = sched.preemptor.preempt_batch
+
+    def capture_pb(prof, items):
+        captured.extend(fe for _, fe in items)
+        return orig_pb(prof, items)
+
+    sched.preemptor.preempt_batch = capture_pb
+    # fits only on the labeled node by selector, but is too big for it
+    client.create_pod(
+        make_pod("p").container(cpu="4").node_selector(disk="ssd").obj()
+    )
+    deadline = time.time() + 10
+    while not captured and time.time() < deadline:
+        sched.schedule_batch(timeout=0.2)
+    sched.stop()
+    informers.stop()
+    assert captured, "pod never hit the fit-error path"
+    statuses = captured[0].filtered_nodes_statuses
+    assert (
+        statuses["nomatch"].code == StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE
+    )
+    assert "match" not in statuses  # resource misfit: preemption may help
+
+
+def test_batch_preemption_end_to_end_device():
+    """Full-cluster preemption through the BatchScheduler: high-priority
+    burst evicts low-priority pods via the DEVICE victim search and
+    eventually binds."""
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(client, informers, batch=True, max_batch=16)
+    for i in range(4):
+        client.create_node(
+            make_node(f"n{i}").capacity(cpu="4", memory="8Gi", pods=10).obj()
+        )
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    # fill the cluster completely with low-priority pods
+    for i in range(8):
+        client.create_pod(
+            make_pod(f"low{i}").container(cpu="2", memory="2Gi")
+            .priority(0).obj()
+        )
+    t = sched.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        pods, _ = client.list_pods()
+        if sum(1 for p in pods if p.spec.node_name) >= 8:
+            break
+        time.sleep(0.05)
+    # high-priority pod must preempt
+    client.create_pod(
+        make_pod("high").container(cpu="3", memory="3Gi").priority(100).obj()
+    )
+    deadline = time.time() + 30
+    bound = False
+    while time.time() < deadline:
+        try:
+            p = client.get_pod("default", "high")
+        except KeyError:
+            break
+        if p.spec.node_name:
+            bound = True
+            break
+        time.sleep(0.05)
+    sched.stop()
+    informers.stop()
+    assert bound, "high-priority pod never bound after preemption"
+    assert sched.preemptor.device_preemptions >= 1
+    assert sched.preemptor.host_preemptions == 0
