@@ -112,7 +112,10 @@ class DisruptionController:
         if pdb.min_available is not None:
             desired = pdb.min_available
         elif pdb.max_unavailable is not None:
-            desired = expected - pdb.max_unavailable
+            # floored at 0 like the reference's
+            # getExpectedPodCountAndDesiredHealthy, so allowed never
+            # exceeds the matching-pod count
+            desired = max(0, expected - pdb.max_unavailable)
         else:
             desired = expected  # no budget spec: nothing disruptable
         allowed = max(0, healthy - desired)

@@ -142,6 +142,11 @@ class Framework:
     def has_plugins(self, point: str) -> bool:
         return bool(self._by_point[point])
 
+    def plugin_instance(self, name: str) -> Optional[Plugin]:
+        """The built plugin instance (device packers read plugin args
+        like hard_pod_affinity_weight from it)."""
+        return self._instances.get(name)
+
     def plugins_relevant(self, point: str, pod: Pod) -> bool:
         """True when at least one plugin at ``point`` may act on this pod
         (no relevance predicate counts as always-relevant)."""

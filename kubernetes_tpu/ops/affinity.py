@@ -47,7 +47,9 @@ MAX_AFF_ROWS = 16
 MAX_ANTI_ROWS = 16
 MAX_EXIST_ROWS = 64
 MAX_TERMS_PER_POD = 4
-MAX_VALUES = 128  # interned values per topology key
+from kubernetes_tpu.tensors.node_tensor import value_capacity
+
+MAX_VALUES = 128  # interned-value floor (tensors.value_capacity grows it)
 
 
 def _selector_sig(sel: Optional[LabelSelector]) -> Tuple:
@@ -181,6 +183,7 @@ def pack_affinity_batch(
     infos = snapshot.list_node_infos()
     n_cap = nt.capacity
 
+    v_cap = value_capacity(n_cap)
     keys: Dict[str, int] = {}
     value_ids: List[Dict[str, int]] = []
 
@@ -316,16 +319,16 @@ def pack_affinity_batch(
                 continue
             vid = ids.get(val)
             if vid is None:
-                if len(ids) >= MAX_VALUES:
+                if len(ids) >= v_cap:
                     return None
                 vid = len(ids)
                 ids[val] = vid
             node_value[k, j] = vid
 
     # ---- count initialization from existing pods --------------------------
-    counts_aff = np.zeros((MAX_AFF_ROWS, MAX_VALUES), dtype=np.int32)
-    counts_anti = np.zeros((MAX_ANTI_ROWS, MAX_VALUES), dtype=np.int32)
-    counts_exist = np.zeros((MAX_EXIST_ROWS, MAX_VALUES), dtype=np.int32)
+    counts_aff = np.zeros((MAX_AFF_ROWS, v_cap), dtype=np.int32)
+    counts_anti = np.zeros((MAX_ANTI_ROWS, v_cap), dtype=np.int32)
+    counts_exist = np.zeros((MAX_EXIST_ROWS, v_cap), dtype=np.int32)
 
     # exist rows: one bump per (existing pod, term) at the pod's node value
     # (filtering.go:212; the batch pods' own rows start at zero)
@@ -435,16 +438,16 @@ def noop_affinity_tensors(padded: int, n_cap: int) -> Tuple[np.ndarray, ...]:
     greedy_assign_constrained argument order."""
     return (
         np.full((MAX_KEYS, n_cap), -1, dtype=np.int32),
-        np.zeros((MAX_AFF_ROWS, MAX_VALUES), dtype=np.int32),
+        np.zeros((MAX_AFF_ROWS, value_capacity(n_cap)), dtype=np.int32),
         np.full(MAX_AFF_ROWS, -1, dtype=np.int32),
         np.full((padded, MAX_TERMS_PER_POD), -1, dtype=np.int32),
         np.zeros(padded, dtype=bool),
         np.zeros((padded, MAX_AFF_ROWS), dtype=np.int32),
-        np.zeros((MAX_ANTI_ROWS, MAX_VALUES), dtype=np.int32),
+        np.zeros((MAX_ANTI_ROWS, value_capacity(n_cap)), dtype=np.int32),
         np.full(MAX_ANTI_ROWS, -1, dtype=np.int32),
         np.full((padded, MAX_TERMS_PER_POD), -1, dtype=np.int32),
         np.zeros((padded, MAX_ANTI_ROWS), dtype=np.int32),
-        np.zeros((MAX_EXIST_ROWS, MAX_VALUES), dtype=np.int32),
+        np.zeros((MAX_EXIST_ROWS, value_capacity(n_cap)), dtype=np.int32),
         np.full(MAX_EXIST_ROWS, -1, dtype=np.int32),
         np.zeros((padded, MAX_EXIST_ROWS), dtype=bool),
         np.zeros((padded, MAX_EXIST_ROWS), dtype=np.int32),
@@ -490,14 +493,3 @@ def batch_has_affinity(pods: List[Pod]) -> bool:
 def batch_has_required_anti_affinity(pods: List[Pod]) -> bool:
     return any(_required_anti_affinity(p) for p in pods)
 
-
-def pod_has_preferred_affinity(pod: Pod) -> bool:
-    a = pod.spec.affinity
-    if a is None:
-        return False
-    if a.pod_affinity is not None and a.pod_affinity.preferred_during_scheduling:
-        return True
-    return (
-        a.pod_anti_affinity is not None
-        and bool(a.pod_anti_affinity.preferred_during_scheduling)
-    )

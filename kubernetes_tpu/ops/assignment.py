@@ -516,12 +516,19 @@ def greedy_assign_constrained(
     (sc_direct, sc_nodeaff, sc_taint, sc_pod_sig,
      sc_sel_counts0, sc_zone_onehot, sc_zone_id, sc_pod_sel_group,
      sc_pod_sel_match, sc_soft_counts0, sc_soft_node_value,
-     sc_pod_soft_groups, sc_pod_soft_match, sc_weights) = scoring
-    w_na, w_tt, w_sel, w_soft = (
-        sc_weights[0], sc_weights[1], sc_weights[2], sc_weights[3]
+     sc_pod_soft_groups, sc_pod_soft_match,
+     sc_ipa_node_value, sc_ipa_counts0, sc_ipa_wcounts0,
+     sc_pod_ipa_weight, sc_pod_ipa_match, sc_pod_ipa_bump,
+     sc_weights) = scoring
+    w_na, w_tt, w_sel, w_soft, w_ipa = (
+        sc_weights[0], sc_weights[1], sc_weights[2], sc_weights[3],
+        sc_weights[4],
     )
     big_soft = jnp.int32(1 << 20)
     soft_iota = jnp.arange(sc_soft_counts0.shape[0], dtype=jnp.int32)
+    ipa_iota = jnp.arange(sc_ipa_counts0.shape[0], dtype=jnp.int32)
+    v_ipa = sc_ipa_counts0.shape[1]
+    ipa_live = (sc_ipa_node_value >= 0).any()
 
     static_mask = mask_rows[mask_index]
     caps = allocatable[:, :2]
@@ -544,12 +551,13 @@ def greedy_assign_constrained(
     def step(carry, inputs):
         (req_state, nzr_state, sp_counts,
          counts_aff, counts_anti, counts_exist,
-         sel_counts, soft_counts) = carry
+         sel_counts, soft_counts, ipa_counts, ipa_wcounts) = carry
         (pod_req, p_nzr, smask, is_active,
          groups, skews, selfs, match,
          aff_rows, self_match, bump_aff,
          anti_rows, bump_anti, exist_match, bump_exist,
-         sig, sel_group, sel_match, soft_groups, soft_match) = inputs
+         sig, sel_group, sel_match, soft_groups, soft_match,
+         ipa_weight, ipa_match, ipa_bump) = inputs
 
         free = allocatable - req_state
         fits = _fits(free, pod_req)
@@ -677,6 +685,36 @@ def greedy_assign_constrained(
         )
         score = score + jnp.where(has_soft, w_soft * soft_score, 0.0)
 
+        # preferred inter-pod affinity (interpodaffinity/scoring.go):
+        # raw(node) = sum_r weight_r * counts_r[val] (incoming terms)
+        #           + sum_r match_r * wcounts_r[val] (existing pods'
+        #             symmetric terms), normalized [min,max] -> [0,100]
+        # over the feasible set with zero-seeded extremes (:294)
+        ipa_cnt = jnp.take_along_axis(
+            ipa_counts, jnp.clip(sc_ipa_node_value, 0, v_ipa - 1), axis=1
+        )  # [Rp, N]
+        ipa_wcnt = jnp.take_along_axis(
+            ipa_wcounts, jnp.clip(sc_ipa_node_value, 0, v_ipa - 1), axis=1
+        )
+        row_has_val = sc_ipa_node_value >= 0
+        ipa_raw = (
+            jnp.where(row_has_val, ipa_cnt, 0.0) * ipa_weight[:, None]
+            + jnp.where(row_has_val, ipa_wcnt, 0.0) * ipa_match[:, None]
+        ).sum(0)  # [N]
+        ipa_mn = jnp.minimum(
+            0.0, jnp.min(jnp.where(feasible, ipa_raw, 0.0))
+        )
+        ipa_mx = jnp.maximum(
+            0.0, jnp.max(jnp.where(feasible, ipa_raw, 0.0))
+        )
+        ipa_diff = ipa_mx - ipa_mn
+        ipa_score = jnp.where(
+            ipa_diff > 0,
+            jnp.floor(100.0 * (ipa_raw - ipa_mn) / jnp.maximum(ipa_diff, 1e-9) + 1e-4),
+            0.0,
+        )
+        score = score + jnp.where(ipa_live, w_ipa * ipa_score, 0.0)
+
         score = jnp.where(feasible, score, -jnp.inf)
         choice = jnp.argmax(score).astype(jnp.int32)
         placed = feasible.any() & is_active
@@ -718,14 +756,28 @@ def greedy_assign_constrained(
             bump_exist * (ve >= 0) * placed_i
         )
 
+        # preferred-affinity replay: the placed pod is an "existing pod"
+        # for every later batch pod -- it bumps each row's match count
+        # where it matches, and contributes its own terms' signed mass
+        placed_f = placed.astype(jnp.float32)
+        vi = sc_ipa_node_value[:, choice]  # [Rp]
+        vi_ok = (vi >= 0).astype(jnp.float32)
+        ipa_counts = ipa_counts.at[ipa_iota, jnp.clip(vi, 0)].add(
+            ipa_match * vi_ok * placed_f
+        )
+        ipa_wcounts = ipa_wcounts.at[ipa_iota, jnp.clip(vi, 0)].add(
+            ipa_bump * vi_ok * placed_f
+        )
+
         carry = (req_state, nzr_state, sp_counts,
                  counts_aff, counts_anti, counts_exist,
-                 sel_counts, soft_counts)
+                 sel_counts, soft_counts, ipa_counts, ipa_wcounts)
         return carry, assignment
 
     carry0 = (requested, nzr, sp_counts0,
               af_counts_aff0, af_counts_anti0, af_counts_exist0,
-              sc_sel_counts0, sc_soft_counts0)
+              sc_sel_counts0, sc_soft_counts0, sc_ipa_counts0,
+              sc_ipa_wcounts0)
     xs = (
         pod_requests, pod_nzr, static_mask, active,
         sp_pod_groups, sp_pod_max_skew, sp_pod_self, sp_pod_match,
@@ -734,8 +786,9 @@ def greedy_assign_constrained(
         af_pod_bump_exist,
         sc_pod_sig, sc_pod_sel_group, sc_pod_sel_match,
         sc_pod_soft_groups, sc_pod_soft_match,
+        sc_pod_ipa_weight, sc_pod_ipa_match, sc_pod_ipa_bump,
     )
-    (req_out, nzr_out, _, _, _, _, _, _), assignments = jax.lax.scan(
+    (req_out, nzr_out, *_rest), assignments = jax.lax.scan(
         step, carry0, xs, unroll=SCAN_UNROLL
     )
     return assignments, req_out, nzr_out

@@ -28,10 +28,17 @@ the sequential path:
   flipped-linear normalize against (total - min) over feasible eligible
   nodes.
 
-InterPodAffinity's preferred-term scoring is NOT here: pods carrying
-preferred pod-affinity terms fall back to the sequential path
-(batch.solver_supported), and existing pods' preferred terms are a
-documented score divergence for batch-solved pods.
+- **preferred inter-pod affinity** (interpodaffinity/scoring.go:110-268)
+  -- weighted topology count tensors per deduplicated term: the incoming
+  pod's preferred (anti-)affinity terms gather unweighted match counts
+  (``ipa_counts``) scaled by the pod-side signed weights, and existing
+  pods' terms (required affinity x hardPodAffinityWeight, preferred
+  affinity +w, preferred anti-affinity -w) accumulate owner-weighted
+  mass at the owner's topology value (``ipa_wcounts``) gathered where
+  the incoming pod matches. Both tensors replay within the batch (a
+  placed pod bumps counts it matches and contributes its own terms'
+  mass), normalized per step [min,max] -> [0,100] over the feasible set
+  with zero-seeded extremes (scoring.go:294).
 """
 
 from __future__ import annotations
@@ -60,22 +67,69 @@ from kubernetes_tpu.plugins.selectorspread import (
     default_selector,
     get_zone_key,
 )
-from kubernetes_tpu.tensors.node_tensor import NodeTensor
+from kubernetes_tpu.tensors.node_tensor import (
+    NodeTensor,
+    value_capacity as _value_capacity_shared,
+)
 
 MAX_SCORE_SIGS = 16
 SIG_BUCKET = 4
 MAX_SEL_GROUPS = 8
 MAX_ZONES = 64
 MAX_SOFT_GROUPS = 16
-MAX_SOFT_VALUES = 128
+MAX_SOFT_VALUES = 128  # floor; grows to node capacity (hostname keys)
 MAX_SOFT_CONSTRAINTS = 4
+MAX_IPA_ROWS = 16
+MAX_IPA_VALUES = 128  # floor; tensors.node_tensor.value_capacity grows it
+
+
+def _preferred_aff_terms(pod: Pod):
+    a = pod.spec.affinity
+    if a is None or a.pod_affinity is None:
+        return []
+    return a.pod_affinity.preferred_during_scheduling
+
+
+def _preferred_anti_terms(pod: Pod):
+    a = pod.spec.affinity
+    if a is None or a.pod_anti_affinity is None:
+        return []
+    return a.pod_anti_affinity.preferred_during_scheduling
+
+
+def _required_aff_terms(pod: Pod):
+    a = pod.spec.affinity
+    if a is None or a.pod_affinity is None:
+        return []
+    return a.pod_affinity.required_during_scheduling
+
+
+def cluster_has_affinity_scoring(snapshot: Snapshot) -> bool:
+    """True when any existing pod carries terms that score EVERY incoming
+    pod symmetrically (scoring.go:111 processExistingPod: required
+    affinity x hardPodAffinityWeight, preferred (anti-)affinity) -- such
+    clusters need the preferred-affinity tensors for every batch."""
+    for ni in snapshot.have_pods_with_affinity_list:
+        for p in ni.pods_with_affinity:
+            if (
+                _required_aff_terms(p)
+                or _preferred_aff_terms(p)
+                or _preferred_anti_terms(p)
+            ):
+                return True
+    return False
 
 
 def batch_score_dynamic(pods: List[Pod], informers) -> bool:
     """True when the batch's scoring depends on host pod-placement state
-    (selector spread or soft topology spread) -- the dispatch pipeline
-    must drain in-flight batches BEFORE packing such batches."""
+    (selector spread, soft topology spread, or preferred inter-pod
+    affinity) -- the dispatch pipeline must drain in-flight batches
+    BEFORE packing such batches."""
     if any(_soft_constraints(p) for p in pods):
+        return True
+    if any(
+        _preferred_aff_terms(p) or _preferred_anti_terms(p) for p in pods
+    ):
         return True
     if informers is None:
         return False
@@ -117,8 +171,15 @@ class ScoreBatch:
     soft_node_value[Gt, N] int32   per-group node topology value (-1 absent)
     pod_soft_groups[B, C] int32    the pod's soft constraint groups
     pod_soft_match [B, Gt] int32   placement bumps these groups
-    weights        [4] float32     (nodeaffinity, tainttoleration,
-                                   selectorspread, softspread)
+    ipa_node_value [Rp, N] int32   per-ipa-row node topology value
+    ipa_counts     [Rp, V] f32     unweighted match counts per value
+    ipa_wcounts    [Rp, V] f32     owner-weighted symmetric mass
+    pod_ipa_weight [B, Rp] f32     incoming preferred +-weights per row
+    pod_ipa_match  [B, Rp] f32     pod matches the row's selector
+    pod_ipa_bump   [B, Rp] f32     pod's own signed term mass (replay)
+    weights        [5] float32     (nodeaffinity, tainttoleration,
+                                   selectorspread, softspread,
+                                   interpodaffinity)
     """
 
     direct_rows: np.ndarray
@@ -134,8 +195,14 @@ class ScoreBatch:
     soft_node_value: np.ndarray
     pod_soft_groups: np.ndarray
     pod_soft_match: np.ndarray
+    ipa_node_value: np.ndarray  # [Rp, N] int32 per-row node topo value
+    ipa_counts: np.ndarray  # [Rp, V] f32 unweighted match counts
+    ipa_wcounts: np.ndarray  # [Rp, V] f32 owner-weighted symmetric mass
+    pod_ipa_weight: np.ndarray  # [B, Rp] f32 incoming preferred +-w
+    pod_ipa_match: np.ndarray  # [B, Rp] f32 pod matches row selector
+    pod_ipa_bump: np.ndarray  # [B, Rp] f32 pod's own signed term mass
     weights: np.ndarray
-    dynamic: bool = False  # True when sel/soft families are live
+    dynamic: bool = False  # True when sel/soft/ipa families are live
 
 
 def _selector_sig(sel) -> Tuple:
@@ -199,6 +266,8 @@ def pack_score_batch(
     nt: NodeTensor,
     informers,
     weights: Dict[str, int],
+    hard_pod_affinity_weight: int = 1,
+    cluster_affinity_scoring: Optional[bool] = None,
 ) -> Optional[ScoreBatch]:
     """Returns None when no non-resource scorer can influence ranking for
     this batch (the common fast path); raises ScoreEnvelopeExceeded when
@@ -252,9 +321,23 @@ def pack_score_batch(
                 selectors[i] = cs
                 need_sel = True
 
+    # preferred inter-pod affinity is live when any incoming pod carries
+    # preferred terms OR any existing pod scores incoming pods
+    # symmetrically (scoring.go:111; the caller may pass the cluster
+    # answer it already computed for its drain decision)
+    if cluster_affinity_scoring is None:
+        cluster_affinity_scoring = cluster_has_affinity_scoring(snapshot)
+    need_ipa = bool(weights.get("InterPodAffinity", 0)) and (
+        any(
+            _preferred_aff_terms(p) or _preferred_anti_terms(p)
+            for p in pods
+        )
+        or cluster_affinity_scoring
+    )
+
     if not (
         need_images or need_nodeaff or need_avoid or need_taint
-        or need_soft or need_sel
+        or need_soft or need_sel or need_ipa
     ):
         return None
 
@@ -397,7 +480,8 @@ def pack_score_batch(
                     pod_sel_match[i, g] = 1
 
     # ---- soft topology spread groups -------------------------------------
-    soft_counts = np.zeros((MAX_SOFT_GROUPS, MAX_SOFT_VALUES), dtype=np.int32)
+    v_soft = _value_capacity_shared(n_cap, MAX_SOFT_VALUES)
+    soft_counts = np.zeros((MAX_SOFT_GROUPS, v_soft), dtype=np.int32)
     soft_node_value = np.full((MAX_SOFT_GROUPS, n_cap), -1, dtype=np.int32)
     pod_soft_groups = np.full((b, MAX_SOFT_CONSTRAINTS), -1, dtype=np.int32)
     pod_soft_match = np.zeros((b, MAX_SOFT_GROUPS), dtype=np.int32)
@@ -438,7 +522,7 @@ def pack_score_batch(
                     continue
                 vid = value_ids.get(val)
                 if vid is None:
-                    if len(value_ids) >= MAX_SOFT_VALUES:
+                    if len(value_ids) >= v_soft:
                         raise ScoreEnvelopeExceeded("too many soft values")
                     vid = len(value_ids)
                     value_ids[val] = vid
@@ -458,12 +542,139 @@ def pack_score_batch(
                 ):
                     pod_soft_match[i, g] = 1
 
+    # ---- preferred inter-pod affinity (scoring.go:110-268) ----------------
+    v_ipa = _value_capacity_shared(n_cap, MAX_IPA_VALUES)
+    ipa_node_value = np.full((MAX_IPA_ROWS, n_cap), -1, dtype=np.int32)
+    ipa_counts = np.zeros((MAX_IPA_ROWS, v_ipa), dtype=np.float32)
+    ipa_wcounts = np.zeros((MAX_IPA_ROWS, v_ipa), dtype=np.float32)
+    pod_ipa_weight = np.zeros((b, MAX_IPA_ROWS), dtype=np.float32)
+    pod_ipa_match = np.zeros((b, MAX_IPA_ROWS), dtype=np.float32)
+    pod_ipa_bump = np.zeros((b, MAX_IPA_ROWS), dtype=np.float32)
+    if need_ipa:
+        from kubernetes_tpu.ops.affinity import (
+            _Matcher,
+            _selector_sig as _aff_sel_sig,
+            _term_namespaces,
+        )
+
+        matcher = _Matcher()
+        ipa_rows: List[Tuple] = []  # (namespaces, selector, sel_sig, key)
+        ipa_row_ids: Dict[Tuple, int] = {}
+        row_value_ids: List[Dict[str, int]] = []
+
+        def ipa_row(owner: Pod, term) -> int:
+            sig = (
+                _term_namespaces(owner, term),
+                _aff_sel_sig(term.label_selector),
+                term.topology_key,
+            )
+            r = ipa_row_ids.get(sig)
+            if r is None:
+                if len(ipa_rows) >= MAX_IPA_ROWS:
+                    raise ScoreEnvelopeExceeded(
+                        "too many preferred-affinity rows"
+                    )
+                r = len(ipa_rows)
+                ipa_row_ids[sig] = r
+                ipa_rows.append(
+                    (
+                        _term_namespaces(owner, term),
+                        term.label_selector,
+                        _aff_sel_sig(term.label_selector),
+                        term.topology_key,
+                    )
+                )
+                ids: Dict[str, int] = {}
+                row_value_ids.append(ids)
+                for j, ni in enumerate(infos):
+                    node = ni.node
+                    if node is None:
+                        continue
+                    val = node.metadata.labels.get(term.topology_key)
+                    if val is None:
+                        continue
+                    vid = ids.get(val)
+                    if vid is None:
+                        if len(ids) >= v_ipa:
+                            raise ScoreEnvelopeExceeded(
+                                "too many preferred-affinity values"
+                            )
+                        vid = len(ids)
+                        ids[val] = vid
+                    ipa_node_value[r, j] = vid
+            return r
+
+        def signed_terms(pod: Pod):
+            """(term, signed_weight) for everything this pod contributes
+            as an EXISTING pod (processExistingPod :111): required
+            affinity x hard weight, preferred affinity +w, preferred
+            anti-affinity -w."""
+            out = []
+            if hard_pod_affinity_weight > 0:
+                for t in _required_aff_terms(pod):
+                    out.append((t, float(hard_pod_affinity_weight)))
+            for wt in _preferred_aff_terms(pod):
+                out.append((wt.pod_affinity_term, float(wt.weight)))
+            for wt in _preferred_anti_terms(pod):
+                out.append((wt.pod_affinity_term, -float(wt.weight)))
+            return out
+
+        # incoming pods' preferred terms (family a: count-gather rows)
+        for i, p in enumerate(pods):
+            for wt in _preferred_aff_terms(p):
+                r = ipa_row(p, wt.pod_affinity_term)
+                pod_ipa_weight[i, r] += float(wt.weight)
+            for wt in _preferred_anti_terms(p):
+                r = ipa_row(p, wt.pod_affinity_term)
+                pod_ipa_weight[i, r] -= float(wt.weight)
+            # the pod's own symmetric contributions once placed
+            for t, wgt in signed_terms(p):
+                r = ipa_row(p, t)
+                pod_ipa_bump[i, r] += wgt
+
+        node_of_pod = {}
+        for j, ni in enumerate(infos):
+            for e in ni.pods:
+                node_of_pod[id(e)] = j
+
+        # existing pods' symmetric terms (family c: weighted mass at the
+        # owner's topology value)
+        for ni in snapshot.have_pods_with_affinity_list:
+            if ni.node is None:
+                continue
+            for e in ni.pods_with_affinity:
+                j = node_of_pod.get(id(e))
+                if j is None:
+                    continue
+                for t, wgt in signed_terms(e):
+                    r = ipa_row(e, t)
+                    v = ipa_node_value[r, j]
+                    if v >= 0:
+                        ipa_wcounts[r, v] += wgt
+
+        # family-a counts: matching EXISTING pods per row per value, and
+        # the per-pod match matrix (count replay + family-c gather)
+        for j, ni in enumerate(infos):
+            if ni.node is None:
+                continue
+            for e in ni.pods:
+                for r, (nss, sel, sel_sig, _key) in enumerate(ipa_rows):
+                    if matcher.matches(e, nss, sel, sel_sig):
+                        v = ipa_node_value[r, j]
+                        if v >= 0:
+                            ipa_counts[r, v] += 1.0
+        for i, p in enumerate(pods):
+            for r, (nss, sel, sel_sig, _key) in enumerate(ipa_rows):
+                if matcher.matches(p, nss, sel, sel_sig):
+                    pod_ipa_match[i, r] = 1.0
+
     w = np.array(
         [
             float(weights.get("NodeAffinity", 0)),
             float(weights.get("TaintToleration", 0)),
             float(weights.get("DefaultPodTopologySpread", 0)),
             float(weights.get("PodTopologySpread", 0)),
+            float(weights.get("InterPodAffinity", 0)),
         ],
         dtype=np.float32,
     )
@@ -481,8 +692,14 @@ def pack_score_batch(
         soft_node_value=soft_node_value,
         pod_soft_groups=pod_soft_groups,
         pod_soft_match=pod_soft_match,
+        ipa_node_value=ipa_node_value,
+        ipa_counts=ipa_counts,
+        ipa_wcounts=ipa_wcounts,
+        pod_ipa_weight=pod_ipa_weight,
+        pod_ipa_match=pod_ipa_match,
+        pod_ipa_bump=pod_ipa_bump,
         weights=w,
-        dynamic=need_sel or need_soft,
+        dynamic=need_sel or need_soft or need_ipa,
     )
 
 
@@ -530,11 +747,26 @@ def noop_score_tensors(padded: int, n_cap: int) -> Tuple[np.ndarray, ...]:
         np.full(n_cap, -1, dtype=np.int32),
         np.full(padded, -1, dtype=np.int32),
         np.zeros((padded, MAX_SEL_GROUPS), dtype=np.int32),
-        np.zeros((MAX_SOFT_GROUPS, MAX_SOFT_VALUES), dtype=np.int32),
+        np.zeros(
+            (MAX_SOFT_GROUPS, _value_capacity_shared(n_cap, MAX_SOFT_VALUES)),
+            dtype=np.int32,
+        ),
         np.full((MAX_SOFT_GROUPS, n_cap), -1, dtype=np.int32),
         np.full((padded, MAX_SOFT_CONSTRAINTS), -1, dtype=np.int32),
         np.zeros((padded, MAX_SOFT_GROUPS), dtype=np.int32),
-        np.zeros(4, dtype=np.float32),
+        np.full((MAX_IPA_ROWS, n_cap), -1, dtype=np.int32),
+        np.zeros(
+            (MAX_IPA_ROWS, _value_capacity_shared(n_cap, MAX_IPA_VALUES)),
+            dtype=np.float32,
+        ),
+        np.zeros(
+            (MAX_IPA_ROWS, _value_capacity_shared(n_cap, MAX_IPA_VALUES)),
+            dtype=np.float32,
+        ),
+        np.zeros((padded, MAX_IPA_ROWS), dtype=np.float32),
+        np.zeros((padded, MAX_IPA_ROWS), dtype=np.float32),
+        np.zeros((padded, MAX_IPA_ROWS), dtype=np.float32),
+        np.zeros(5, dtype=np.float32),
     )
 
 
@@ -562,5 +794,11 @@ def pad_score_tensors(sb: ScoreBatch, padded: int) -> Tuple[np.ndarray, ...]:
         sb.soft_node_value,
         pad_pods(sb.pod_soft_groups, -1),
         pad_pods(sb.pod_soft_match, 0),
+        sb.ipa_node_value,
+        sb.ipa_counts,
+        sb.ipa_wcounts,
+        pad_pods(sb.pod_ipa_weight, 0.0),
+        pad_pods(sb.pod_ipa_match, 0.0),
+        pad_pods(sb.pod_ipa_bump, 0.0),
         sb.weights,
     )

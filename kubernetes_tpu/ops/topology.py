@@ -35,10 +35,10 @@ from kubernetes_tpu.api.selectors import labels_match_selector
 from kubernetes_tpu.api.types import LabelSelector, Pod
 from kubernetes_tpu.cache.snapshot import Snapshot
 from kubernetes_tpu.plugins.podtopologyspread import DO_NOT_SCHEDULE
-from kubernetes_tpu.tensors.node_tensor import NodeTensor
+from kubernetes_tpu.tensors.node_tensor import NodeTensor, value_capacity
 
 MAX_GROUPS = 16  # batches needing more fall back to the host path
-MAX_VALUES = 128
+MAX_VALUES = 128  # floor; tensors.node_tensor.value_capacity grows it
 MAX_CONSTRAINTS_PER_POD = 4
 BIG = np.int32(1 << 20)  # "absent value" sentinel for the min-reduce
 
@@ -173,8 +173,9 @@ def pack_spread_batch(
                 pod_match[i, g] = 1
 
     n_cap = nt.capacity
-    group_counts = np.zeros((MAX_GROUPS, MAX_VALUES), dtype=np.int32)
-    value_valid = np.zeros((MAX_GROUPS, MAX_VALUES), dtype=bool)
+    v_cap = value_capacity(n_cap)
+    group_counts = np.zeros((MAX_GROUPS, v_cap), dtype=np.int32)
+    value_valid = np.zeros((MAX_GROUPS, v_cap), dtype=bool)
     node_value = np.full((MAX_GROUPS, n_cap), -1, dtype=np.int32)
 
     for g, (ns, key, sel) in enumerate(specs):
@@ -188,7 +189,7 @@ def pack_spread_batch(
                 continue  # node lacks the key: hard-excluded for this group
             vid = value_ids.get(val)
             if vid is None:
-                if len(value_ids) >= MAX_VALUES:
+                if len(value_ids) >= v_cap:
                     return None
                 vid = len(value_ids)
                 value_ids[val] = vid
@@ -223,8 +224,8 @@ def noop_spread_tensors(padded: int, n_cap: int):
     """All-inactive spread tensors (kernel no-op), in
     greedy_assign_constrained argument order."""
     return (
-        np.zeros((MAX_GROUPS, MAX_VALUES), dtype=np.int32),
-        np.zeros((MAX_GROUPS, MAX_VALUES), dtype=bool),
+        np.zeros((MAX_GROUPS, value_capacity(n_cap)), dtype=np.int32),
+        np.zeros((MAX_GROUPS, value_capacity(n_cap)), dtype=bool),
         np.full((MAX_GROUPS, n_cap), -1, dtype=np.int32),
         np.full((padded, MAX_CONSTRAINTS_PER_POD), -1, dtype=np.int32),
         np.zeros((padded, MAX_CONSTRAINTS_PER_POD), dtype=np.int32),

@@ -64,6 +64,16 @@ class Coscheduling(Plugin):
             and p.metadata.labels.get(POD_GROUP_LABEL) == group
         )
 
+    def group_quorum_info(self, pod: Pod, group: str):
+        """Public quorum query for the batch solver's all-or-nothing
+        group masks: (min_member, total known members). The same
+        knowledge horizon as pre_filter's fail-fast."""
+        pg = self._pod_group(pod, group)
+        return (
+            pg.min_member if pg is not None else 1,
+            self._count_total_members(pod, group),
+        )
+
     def _count_holding_members(self, pod: Pod, group: str) -> int:
         """Distinct members currently holding resources: bound/assumed
         pods in the snapshot, pods parked at Permit, and the pod being

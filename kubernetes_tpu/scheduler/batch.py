@@ -31,7 +31,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from kubernetes_tpu.api.types import Binding, Pod
+from kubernetes_tpu.api.types import Binding, POD_GROUP_LABEL, Pod
 from kubernetes_tpu.framework.interface import (
     CycleState,
     FitError,
@@ -53,12 +53,12 @@ from kubernetes_tpu.ops.affinity import (
     noop_affinity_tensors,
     pack_affinity_batch,
     pad_affinity_tensors,
-    pod_has_preferred_affinity,
 )
 from kubernetes_tpu.ops.host_masks import static_mask_compact
 from kubernetes_tpu.ops.scoring import (
     ScoreEnvelopeExceeded,
     batch_score_dynamic,
+    cluster_has_affinity_scoring,
     noop_score_tensors,
     pack_score_batch,
     pad_score_tensors,
@@ -98,10 +98,8 @@ def solver_supported(pod: Pod) -> bool:
     ):
         return False
     # REQUIRED pod (anti-)affinity solves on device via the count-tensor
-    # replay (ops/affinity.py); preferred terms shape scoring, which the
-    # device scorer set doesn't include yet
-    if pod_has_preferred_affinity(pod):
-        return False
+    # replay (ops/affinity.py); preferred terms ride the weighted
+    # count-tensor score family (ops/scoring.py ipa_*)
     for c in spec.containers:
         for p in c.ports:
             if p.host_port:
@@ -282,10 +280,103 @@ class BatchScheduler(Scheduler):
     def _solve_and_commit(
         self, solver_infos: List[PodInfo], pod_scheduling_cycle: int
     ) -> None:
-        """Synchronous solve: dispatch + download + commit in one call."""
+        """Synchronous solve: dispatch + download + commit in one call,
+        with the gang quorum fixup between solve and commit."""
         pending = self._dispatch_solve(solver_infos, pod_scheduling_cycle)
-        if pending is not None:
-            self._complete_solve(pending)
+        if pending is None:
+            return
+        if any(
+            pi.pod.metadata.labels.get(POD_GROUP_LABEL)
+            for pi in solver_infos
+        ):
+            pending = self._gang_fixup(solver_infos, pending)
+            if pending is None:
+                return
+        self._complete_solve(pending)
+
+    # -- gang all-or-nothing group masks (SURVEY stage 6) --------------------
+
+    def _gang_fixup(self, solver_infos: List[PodInfo], pending):
+        """All-or-nothing placement for PodGroups inside the solver: a
+        group whose placed + potential outside members can't reach
+        min_member is masked inactive and the batch re-solves, so a
+        half-fitting gang reserves NOTHING (no Permit-timeout churn).
+        Permit remains the cross-batch completion gate for groups that
+        can still assemble (framework/v1alpha1/interface.go:384).
+
+        Outside members (held or still pending) count optimistically --
+        the same knowledge horizon as Coscheduling's PreFilter fail-fast
+        (total known members vs min_member), sharpened with this batch's
+        actual capacity outcome."""
+        inactive: set = set()
+        for _attempt in range(2):
+            assignments = np.asarray(pending["assignments_dev"])
+            failed = self._gang_quorum_failures(pending, assignments)
+            failed -= inactive
+            if not failed:
+                pending["gang_failed_uids"] = inactive
+                return pending
+            inactive |= failed
+            with self._shadow_lock:
+                self._dev.invalidate_carry()
+            pending = self._dispatch_solve(
+                solver_infos, pending["cycle"], inactive_uids=inactive
+            )
+            if pending is None:
+                return None  # packers routed the batch to the host path
+        # leftover failures after the final pass are committed as
+        # NO_NODE without a re-solve: their capacity stays reserved in
+        # the device output, so drop the carry
+        assignments = np.asarray(pending["assignments_dev"])
+        leftover = self._gang_quorum_failures(pending, assignments)
+        if leftover - inactive:
+            inactive |= leftover
+            with self._shadow_lock:
+                self._dev.invalidate_carry()
+        pending["gang_failed_uids"] = inactive
+        return pending
+
+    def _gang_quorum_failures(self, pending, assignments) -> set:
+        """UIDs of every member of a group that cannot reach min_member:
+        placed-in-batch + ALL outside known members (held or pending)
+        falls short."""
+        solver_infos = pending["solver_infos"]
+        order = pending["order"]
+        b = pending["b"]
+        groups = {}
+        for k in range(b):
+            pod = solver_infos[int(order[k])].pod
+            g = pod.metadata.labels.get(POD_GROUP_LABEL)
+            if g:
+                groups.setdefault(
+                    (pod.metadata.namespace, g), []
+                ).append(k)
+        if not groups:
+            return set()
+        prof = self.profiles.get(
+            solver_infos[0].pod.spec.scheduler_name
+        )
+        cos = (
+            prof.plugin_instance("Coscheduling") if prof is not None else None
+        )
+        if cos is None:
+            # no Coscheduling plugin: the group label carries no gang
+            # semantics in this profile -- never mask
+            return set()
+        failed: set = set()
+        for (ns, g), ks in groups.items():
+            pod0 = solver_infos[int(order[ks[0]])].pod
+            min_member, total = cos.group_quorum_info(pod0, g)
+            in_batch_uids = {
+                solver_infos[int(order[k])].pod.metadata.uid for k in ks
+            }
+            placed = sum(
+                1 for k in ks if int(assignments[k]) != NO_NODE
+            )
+            outside = max(0, total - len(in_batch_uids))
+            if placed + outside < min_member:
+                failed |= in_batch_uids
+        return failed
 
     def _pending_exists(self) -> bool:
         with self._pending_cv:
@@ -361,7 +452,17 @@ class BatchScheduler(Scheduler):
         self, solver_infos: List[PodInfo], pod_scheduling_cycle: int
     ) -> None:
         """Dispatch this batch and enqueue it for the committer thread;
-        blocks only when MAX_INFLIGHT batches are already in flight."""
+        blocks only when MAX_INFLIGHT batches are already in flight.
+        Gang batches take the synchronous path: the quorum fixup
+        (SURVEY stage 6 all-or-nothing group masks) may re-solve, which
+        must not race in-flight batches."""
+        if any(
+            pi.pod.metadata.labels.get(POD_GROUP_LABEL)
+            for pi in solver_infos
+        ):
+            self._drain_pending()
+            self._solve_and_commit(solver_infos, pod_scheduling_cycle)
+            return
         pending = self._dispatch_solve(solver_infos, pod_scheduling_cycle)
         if pending is None:
             return
@@ -384,7 +485,10 @@ class BatchScheduler(Scheduler):
                 self._pending_cv.wait()
 
     def _dispatch_solve(
-        self, solver_infos: List[PodInfo], pod_scheduling_cycle: int
+        self,
+        solver_infos: List[PodInfo],
+        pod_scheduling_cycle: int,
+        inactive_uids=None,
     ):
         """Pack + upload + dispatch one solver batch. Returns a pending
         record for _complete_solve, or None when the batch was routed to
@@ -427,6 +531,26 @@ class BatchScheduler(Scheduler):
         # their counts must include any in-flight placements
         if not has_affinity and cluster_has_required_anti_affinity(snapshot):
             has_affinity = True
+            if self._pending_exists():
+                self.pipeline_drains += 1
+                self._drain_pending()
+                self.cache.update_snapshot(snapshot)
+                nominated_by_node = self.queue.all_nominated_pods_by_node()
+        # existing pods with symmetric scoring terms make EVERY batch's
+        # preferred-affinity family live (scoring.go:111): the in-flight
+        # counts must land before packing. Gated on the profile actually
+        # scoring with InterPodAffinity -- otherwise the family packs
+        # nothing and the drain would serialize the pipeline for free.
+        ipa_weight = (
+            prof0.score_plugin_weights().get("InterPodAffinity", 0)
+            if prof0 is not None
+            else 0
+        )
+        cluster_ipa = bool(ipa_weight) and cluster_has_affinity_scoring(
+            snapshot
+        )
+        if not score_dynamic and cluster_ipa:
+            score_dynamic = True
             if self._pending_exists():
                 self.pipeline_drains += 1
                 self._drain_pending()
@@ -483,6 +607,14 @@ class BatchScheduler(Scheduler):
         nzr[:b] = batch.non_zero_requests[order]
         midx[:b] = mask_index[order]
         active[:b] = True
+        if inactive_uids:
+            # gang quorum fixup: masked group members solve to NO_NODE
+            for k in range(b):
+                if (
+                    solver_infos[int(order[k])].pod.metadata.uid
+                    in inactive_uids
+                ):
+                    active[k] = False
         u = mask_rows.shape[0]
         u_padded = MASK_ROW_BUCKET * math.ceil(u / MASK_ROW_BUCKET)
         rows = np.zeros((u_padded, nt.capacity), dtype=bool)
@@ -496,10 +628,18 @@ class BatchScheduler(Scheduler):
         # snapshot these counts come from includes in-flight placements)
         ordered_pods = [pods[int(i)] for i in order]
         try:
+            hard_w = 1
+            if prof0 is not None:
+                ipa_plugin = prof0.plugin_instance("InterPodAffinity")
+                hard_w = getattr(
+                    ipa_plugin, "hard_pod_affinity_weight", 1
+                ) if ipa_plugin is not None else 1
             score_batch = pack_score_batch(
                 ordered_pods, snapshot, nt,
                 prof0.informers if prof0 is not None else None,
                 prof0.score_plugin_weights() if prof0 is not None else {},
+                hard_pod_affinity_weight=hard_w,
+                cluster_affinity_scoring=cluster_ipa,
             )
         except ScoreEnvelopeExceeded:
             # the sequential path filters against the host cache, which
@@ -779,6 +919,7 @@ class BatchScheduler(Scheduler):
             p["solver_infos"], p["order"], assignments, p["names"],
             p["num_nodes"], p["snapshot"], p["cycle"],
             mask_info=(p.get("mask_rows"), p.get("mask_index_solved")),
+            gang_failed_uids=p.get("gang_failed_uids"),
         )
 
     # -- batched commit ------------------------------------------------------
@@ -793,6 +934,7 @@ class BatchScheduler(Scheduler):
         snapshot,
         pod_scheduling_cycle: int,
         mask_info=None,
+        gang_failed_uids=None,
     ) -> None:
         """Post-solve pipeline for the whole batch: Reserve -> assume ->
         Permit (scheduler.go:615-660 semantics preserved), then ONE async
@@ -823,6 +965,18 @@ class BatchScheduler(Scheduler):
         for k in range(b):
             pi = solver_infos[int(order[k])]
             choice = int(assignments[k])
+            if gang_failed_uids and pi.pod.metadata.uid in gang_failed_uids:
+                # quorum-masked gang member: no placement, no preemption
+                # (the group chose not to place; a PodGroupMemberAdd
+                # wakeup retries once the group can assemble)
+                metrics.schedule_attempts.inc(result="unschedulable")
+                self.record_scheduling_failure(
+                    prof, pi,
+                    "pod group cannot reach minMember this cycle",
+                    "Unschedulable", "", pod_scheduling_cycle,
+                )
+                self.pods_solved_on_device += 1
+                continue
             if choice == NO_NODE:
                 slow.append((pi, choice, k))
                 continue

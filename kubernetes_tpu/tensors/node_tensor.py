@@ -52,6 +52,18 @@ NODE_BUCKET = 128  # row padding granularity (TPU lane width)
 CPU, MEM, EPH, PODS = 0, 1, 2, 3
 NUM_FIXED_DIMS = 4
 
+VALUE_FLOOR = 128
+
+
+def value_capacity(n_cap: int, floor: int = VALUE_FLOOR) -> int:
+    """Interned topology-value slots per key for the device count
+    tensors (affinity/spread/score families): label values come from
+    node labels, so hostname-keyed terms (the canonical
+    spread-replicas-across-nodes workload) need as many slots as nodes.
+    The cap adapts to the padded node capacity -- n_cap is already
+    bucketed, so the derived shapes are re-JIT-stable per cluster."""
+    return max(floor, n_cap)
+
 
 def _kib_floor(b: int) -> int:
     return b // 1024

@@ -301,8 +301,9 @@ class TestSolverSupported:
             make_pod("p").pod_affinity("zone", {"a": "b"}, anti=True).obj()
         )
 
-    def test_preferred_affinity_not_supported(self):
-        assert not solver_supported(
+    def test_preferred_affinity_supported_on_device(self):
+        # preferred terms ride the ipa_* score family (ops/scoring.py)
+        assert solver_supported(
             make_pod("p").preferred_pod_affinity("zone", {"a": "b"}).obj()
         )
 

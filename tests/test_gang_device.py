@@ -1,0 +1,178 @@
+"""Gang all-or-nothing group masks in the device solver (SURVEY stage 6).
+
+A half-fitting gang must place ZERO pods (no capacity reserved, no
+Permit-timeout churn); a fitting gang places fully and releases through
+Permit. Reference hook: framework/v1alpha1/interface.go:384 (Permit) +
+the out-of-tree coscheduling pattern.
+"""
+
+import time
+
+from kubernetes_tpu.api.types import ObjectMeta, POD_GROUP_LABEL, PodGroup
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _cluster(max_batch=32):
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(client, informers, batch=True, max_batch=max_batch)
+    return server, client, informers, sched
+
+
+def _gang_pod(name, group, cpu="1"):
+    p = make_pod(name).container(cpu=cpu, memory="128Mi").obj()
+    p.metadata.labels[POD_GROUP_LABEL] = group
+    return p
+
+
+def _pg(client, name, min_member):
+    client.create_pod_group(
+        PodGroup(
+            metadata=ObjectMeta(name=name, namespace="default"),
+            min_member=min_member,
+        )
+    )
+
+
+def test_half_fitting_gang_places_nothing():
+    server, client, informers, sched = _cluster()
+    # capacity for 4 gang pods; the gang needs 6
+    for i in range(2):
+        client.create_node(
+            make_node(f"n{i}").capacity(cpu="2", memory="8Gi").obj()
+        )
+    _pg(client, "g6", 6)
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    for i in range(6):
+        client.create_pod(_gang_pod(f"g{i}", "g6"))
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        sched.schedule_batch(timeout=0.2)
+        if sched.queue.num_pending()["unschedulable"] == 6:
+            break
+    sched.wait_for_inflight_binds()
+    pods, _ = client.list_pods()
+    bound = [p for p in pods if p.spec.node_name]
+    # all-or-nothing: NOTHING placed, nothing parked at Permit
+    assert bound == []
+    assert sched.queue.num_pending()["unschedulable"] == 6
+    for fw in sched.profiles.values():
+        assert not fw.waiting_pods.list() if hasattr(
+            fw.waiting_pods, "list"
+        ) else True
+    sched.stop()
+    informers.stop()
+
+
+def test_fitting_gang_places_fully_on_device():
+    server, client, informers, sched = _cluster()
+    for i in range(3):
+        client.create_node(
+            make_node(f"n{i}").capacity(cpu="4", memory="8Gi").obj()
+        )
+    _pg(client, "g6", 6)
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    for i in range(6):
+        client.create_pod(_gang_pod(f"g{i}", "g6"))
+    sched.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        pods, _ = client.list_pods()
+        if sum(1 for p in pods if p.spec.node_name) == 6:
+            break
+        time.sleep(0.05)
+    sched.wait_for_inflight_binds()
+    sched.stop()
+    informers.stop()
+    pods, _ = client.list_pods()
+    assert sum(1 for p in pods if p.spec.node_name) == 6
+
+
+def test_gang_failure_releases_capacity_to_other_pods():
+    """The re-solve gives the failed gang's capacity to later plain pods
+    in the same batch instead of leaving it reserved."""
+    server, client, informers, sched = _cluster()
+    client.create_node(
+        make_node("n0").capacity(cpu="4", memory="8Gi").obj()
+    )
+    _pg(client, "g8", 8)
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    # gang of 8 x 1cpu (needs 8, only 4 fit) + 4 plain 1cpu pods,
+    # created gang-first so they sort ahead in the batch
+    for i in range(8):
+        client.create_pod(_gang_pod(f"g{i}", "g8"))
+    for i in range(4):
+        client.create_pod(
+            make_pod(f"plain{i}").container(cpu="1", memory="128Mi").obj()
+        )
+    sched.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        pods, _ = client.list_pods()
+        plain_bound = sum(
+            1
+            for p in pods
+            if p.spec.node_name and p.metadata.name.startswith("plain")
+        )
+        if plain_bound == 4:
+            break
+        time.sleep(0.05)
+    sched.wait_for_inflight_binds()
+    sched.stop()
+    informers.stop()
+    pods, _ = client.list_pods()
+    gang_bound = [
+        p for p in pods
+        if p.spec.node_name and p.metadata.name.startswith("g")
+    ]
+    plain_bound = [
+        p for p in pods
+        if p.spec.node_name and p.metadata.name.startswith("plain")
+    ]
+    assert gang_bound == []
+    assert len(plain_bound) == 4
+
+
+def test_split_arrival_gang_assembles_via_permit():
+    """A gang split across two batches still assembles: the first half
+    waits at Permit (members known), the second half completes it."""
+    server, client, informers, sched = _cluster()
+    for i in range(4):
+        client.create_node(
+            make_node(f"n{i}").capacity(cpu="2", memory="8Gi").obj()
+        )
+    _pg(client, "g6", 6)
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    # all 6 members exist up front (known to the informer), but the
+    # queue is drained in two waves
+    pods = [_gang_pod(f"g{i}", "g6") for i in range(6)]
+    for p in pods[:4]:
+        client.create_pod(p)
+    sched.start()
+    time.sleep(1.0)
+    for p in pods[4:]:
+        client.create_pod(p)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        got, _ = client.list_pods()
+        if sum(1 for p in got if p.spec.node_name) == 6:
+            break
+        time.sleep(0.05)
+    sched.wait_for_inflight_binds()
+    sched.stop()
+    informers.stop()
+    got, _ = client.list_pods()
+    assert sum(1 for p in got if p.spec.node_name) == 6

@@ -1,0 +1,251 @@
+"""Preferred inter-pod affinity scoring on device: randomized
+batch-vs-sequential differentials plus targeted behavior tests.
+
+Reference: interpodaffinity/scoring.go:110-268 (processExistingPod /
+processTerm) and :294 (NormalizeScore). The sequential path's
+InterPodAffinity plugin is the oracle; the batch path must produce the
+same placements on identical clusters.
+"""
+
+import random
+import time
+
+import pytest
+
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _wait_decided(client, sched, count, timeout=60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        pods, _ = client.list_pods()
+        pending = [
+            p for p in pods
+            if not p.spec.node_name and not p.status.conditions
+        ]
+        if len(pods) >= count and not pending:
+            sched.wait_for_inflight_binds()
+            return client.list_pods()[0]
+        time.sleep(0.05)
+    raise AssertionError("pods not decided in time")
+
+
+def _build_cluster(rng, client):
+    zones = ["z1", "z2", "z3", "z4"]
+    for i in range(12):
+        # distinct capacities keep resource scores tie-free: the
+        # sequential path breaks ties via reservoir RNG + a rotating
+        # start index, which no deterministic device argmax can mirror
+        client.create_node(
+            make_node(f"n{i}")
+            .labels(zone=zones[i % len(zones)], rack=f"r{i % 6}")
+            .capacity(cpu=str(8 + 2 * i), memory=f"{24 + 5 * i}Gi")
+            .obj()
+        )
+    apps = ["web", "db", "cache"]
+    existing = []
+    for j in range(10):
+        w = (
+            make_pod(f"ex{j}")
+            .node(f"n{rng.randrange(12)}")
+            .labels(app=rng.choice(apps))
+            .container(cpu="100m", memory="128Mi")
+        )
+        roll = rng.random()
+        if roll < 0.3:
+            w.preferred_pod_affinity(
+                "zone", {"app": rng.choice(apps)},
+                weight=rng.choice([1, 5, 10]),
+            )
+        elif roll < 0.5:
+            w.preferred_pod_affinity(
+                "zone", {"app": rng.choice(apps)},
+                weight=rng.choice([1, 5]), anti=True,
+            )
+        elif roll < 0.65:
+            w.pod_affinity("rack", {"app": rng.choice(apps)})
+        existing.append(w.obj())
+        client.create_pod(existing[-1])
+    return existing
+
+
+def _build_batch(rng, prefix):
+    apps = ["web", "db", "cache"]
+    out = []
+    for i in range(12):
+        w = (
+            make_pod(f"{prefix}{i}")
+            .labels(app=rng.choice(apps))
+            .creation_timestamp(float(i))
+            .container(cpu="200m", memory="256Mi")
+        )
+        roll = rng.random()
+        if roll < 0.4:
+            w.preferred_pod_affinity(
+                "zone", {"app": rng.choice(apps)},
+                weight=rng.choice([1, 5, 10]),
+            )
+        elif roll < 0.7:
+            w.preferred_pod_affinity(
+                "rack", {"app": rng.choice(apps)},
+                weight=rng.choice([1, 5]), anti=True,
+            )
+        out.append(w.obj())
+    return out
+
+
+class _KeepFirstRng:
+    """Reservoir sampling never replaces: sequential select_host keeps
+    the first max, matching the device argmax (lowest index)."""
+
+    def randrange(self, n):
+        return 1 if n > 1 else 0
+
+    def randint(self, a, b):
+        return b
+
+
+def _run(rng_seed, batch):
+    """Schedule the same random scenario through the batch or the
+    sequential path; returns {pod name: node}."""
+    rng = random.Random(rng_seed)
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(
+        client, informers, batch=batch, max_batch=64,
+        percentage_of_nodes_to_score=100, rng=_KeepFirstRng(),
+    )
+    _build_cluster(rng, client)
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    for p in _build_batch(rng, "m"):
+        client.create_pod(p)
+    sched.start()
+    pods = _wait_decided(client, sched, 22)
+    if batch:
+        assert sched.pods_fallback == 0, "expected pure device solve"
+    sched.stop()
+    informers.stop()
+    return {
+        p.metadata.name: p.spec.node_name
+        for p in pods
+        if p.metadata.name.startswith("m")
+    }
+
+
+@pytest.mark.parametrize("seed", [3, 11, 29])
+def test_batch_matches_sequential_with_preferred_affinity(seed):
+    assert _run(seed, batch=True) == _run(seed, batch=False)
+
+
+def test_preferred_affinity_attracts_within_batch():
+    """A follower with preferred affinity placed AFTER its leader in the
+    same batch lands in the leader's zone (within-batch count replay)."""
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(client, informers, batch=True, max_batch=32)
+    for name, zone in (("a", "z1"), ("b", "z2")):
+        client.create_node(
+            make_node(name).labels(zone=zone)
+            .capacity(cpu="8", memory="16Gi").obj()
+        )
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    client.create_pod(
+        make_pod("leader").labels(app="db").priority(10)
+        .creation_timestamp(0.0)
+        .container(cpu="100m", memory="128Mi").obj()
+    )
+    client.create_pod(
+        make_pod("follower").labels(app="web").creation_timestamp(1.0)
+        .container(cpu="100m", memory="128Mi")
+        .preferred_pod_affinity("zone", {"app": "db"}, weight=100)
+        .obj()
+    )
+    sched.start()
+    pods = _wait_decided(client, sched, 2)
+    sched.stop()
+    informers.stop()
+    by_name = {p.metadata.name: p for p in pods}
+    assert by_name["leader"].spec.node_name
+    assert (
+        by_name["follower"].spec.node_name
+        == by_name["leader"].spec.node_name
+    )
+    assert sched.pods_fallback == 0
+
+
+def test_preferred_anti_affinity_repels_within_batch():
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(client, informers, batch=True, max_batch=32)
+    for name, zone in (("a", "z1"), ("b", "z2")):
+        client.create_node(
+            make_node(name).labels(zone=zone)
+            .capacity(cpu="8", memory="16Gi").obj()
+        )
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    for i in range(2):
+        client.create_pod(
+            make_pod(f"p{i}").labels(app="db")
+            .creation_timestamp(float(i))
+            .container(cpu="100m", memory="128Mi")
+            .preferred_pod_affinity(
+                "zone", {"app": "db"}, weight=100, anti=True
+            )
+            .obj()
+        )
+    sched.start()
+    pods = _wait_decided(client, sched, 2)
+    sched.stop()
+    informers.stop()
+    nodes = {p.spec.node_name for p in pods}
+    assert len(nodes) == 2, f"expected spread, got {nodes}"
+    assert sched.pods_fallback == 0
+
+
+def test_existing_pod_symmetric_terms_score_plain_batch():
+    """An existing pod's preferred affinity toward the incoming pods
+    pulls a PLAIN batch (no terms of its own) to its zone
+    (processExistingPod :111)."""
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(client, informers, batch=True, max_batch=32)
+    for name, zone in (("a", "z1"), ("b", "z2")):
+        client.create_node(
+            make_node(name).labels(zone=zone)
+            .capacity(cpu="8", memory="16Gi").obj()
+        )
+    # existing pod on node a prefers app=web near it, strongly
+    client.create_pod(
+        make_pod("magnet").node("a").labels(app="db")
+        .container(cpu="100m", memory="128Mi")
+        .preferred_pod_affinity("zone", {"app": "web"}, weight=100)
+        .obj()
+    )
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    client.create_pod(
+        make_pod("plain").labels(app="web")
+        .container(cpu="100m", memory="128Mi").obj()
+    )
+    sched.start()
+    pods = _wait_decided(client, sched, 2)
+    sched.stop()
+    informers.stop()
+    by_name = {p.metadata.name: p for p in pods}
+    assert by_name["plain"].spec.node_name == "a"
+    assert sched.pods_fallback == 0
