@@ -132,6 +132,12 @@ def main() -> None:
     warm_watch.stop()
     sched.wait_for_inflight_binds(timeout=60)
 
+    # Freeze the steady-state object graph (nodes, informer caches, warm
+    # pods) out of cyclic-GC scanning (utils/gc_tuning.py rationale).
+    from kubernetes_tpu.utils.gc_tuning import freeze_steady_state_graph
+
+    freeze_steady_state_graph()
+
     # The measured burst.
     burst = [
         make_pod(f"burst-{i}")

@@ -219,6 +219,12 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
     else:
         t = sched.start()
 
+    # freeze the init-fill object graph out of cyclic-GC scans
+    # (utils/gc_tuning.py rationale)
+    from kubernetes_tpu.utils.gc_tuning import freeze_steady_state_graph
+
+    freeze_steady_state_graph()
+
     # -- measured burst -------------------------------------------------------
     pod_spec = wl.get("pod") or {}
     pods = []

@@ -607,6 +607,42 @@ class PodGroup:
 POD_GROUP_LABEL = "pod-group.scheduling.x-k8s.io/name"
 
 
+# ---------------------------------------------------------------------------
+# Event (core/v1 Event, the scheduler-emitted subset)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ObjectReference:
+    """core/v1 ObjectReference (the involvedObject of an Event)."""
+
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+
+
+@dataclass
+class Event:
+    """core/v1 Event as the scheduler's recorder emits it
+    (reference profile.go:39 Recorder; "Scheduled" scheduler.go:544,
+    "FailedScheduling" :378, "Preempted" on victims)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    reason: str = ""
+    message: str = ""
+    type: str = "Normal"  # Normal | Warning
+    source: str = ""  # reporting component (schedulerName)
+    count: int = 1
+    first_timestamp: float = 0.0
+
+    kind: str = "Event"
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
 def pod_resource_requests(pod: Pod) -> ResourceList:
     """Effective resource request of a pod.
 

@@ -96,6 +96,14 @@ class Client:
     def list(self, kind: str) -> Tuple[List[object], int]:
         return self._server.list(kind)
 
+    def list_events(self) -> Tuple[List[object], int]:
+        return self._server.list("Event")
+
+    @property
+    def server(self):
+        """The backing store (the event broadcaster writes through it)."""
+        return self._server
+
     def get(self, kind: str, namespace: str, name: str):
         return self._server.get(kind, namespace, name)
 

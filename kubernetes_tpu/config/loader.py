@@ -20,6 +20,7 @@ from kubernetes_tpu.config.types import (
     Plugin,
     PluginSet,
     Plugins,
+    TPUSolverConfiguration,
 )
 from kubernetes_tpu.scheduler.extender import ExtenderConfig
 
@@ -135,14 +136,33 @@ def load_config_from_dict(raw: Dict[str, Any]) -> KubeSchedulerConfiguration:
         metrics_bind_address=raw.get("metricsBindAddress", ""),
         feature_gates=dict(raw.get("featureGates", {})),
     )
+    solver_raw = raw.get("tpuSolver", {})
+    cfg.tpu_solver = TPUSolverConfiguration(
+        enabled=bool(solver_raw.get("enabled", True)),
+        max_batch=int(solver_raw.get("maxBatch", 256)),
+        solver_mode=solver_raw.get("solverMode", "greedy"),
+        batch_window_seconds=_duration_seconds(
+            solver_raw.get("batchWindow", 0.01)
+        ),
+        mesh_devices=int(solver_raw.get("meshDevices", 0)),
+    )
     cfg.extenders = [_extender(e) for e in raw.get("extenders", [])]
     return cfg
 
 
-def load_config(path: str) -> KubeSchedulerConfiguration:
+def load_config(path: str, validate: bool = True) -> KubeSchedulerConfiguration:
     with open(path) as f:
         raw = yaml.safe_load(f) or {}
-    return load_config_from_dict(raw)
+    cfg = load_config_from_dict(raw)
+    if validate:
+        from kubernetes_tpu.config.validation import validate_config
+
+        errors = validate_config(cfg)
+        if errors:
+            raise ValueError(
+                "invalid KubeSchedulerConfiguration: " + "; ".join(errors)
+            )
+    return cfg
 
 
 class FeatureGate:

@@ -1,0 +1,109 @@
+"""KubeSchedulerConfiguration validation.
+
+Reference: /root/reference/pkg/scheduler/apis/config/validation/
+validation.go (ValidateKubeSchedulerConfiguration) -- the same checks,
+plus this build's tpuSolver block. Returns a list of error strings
+(empty = valid); load_config raises on any.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from kubernetes_tpu.config.types import (
+    KubeSchedulerConfiguration,
+    Plugins,
+)
+
+MAX_WEIGHT = 64 * 1024  # framework/v1alpha1: MaxTotalScore guardrail
+
+
+def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
+    errors: List[str] = []
+    if not 0 <= cfg.percentage_of_nodes_to_score <= 100:
+        errors.append(
+            "percentageOfNodesToScore must be in [0, 100], got "
+            f"{cfg.percentage_of_nodes_to_score}"
+        )
+    if cfg.pod_initial_backoff_seconds <= 0:
+        errors.append("podInitialBackoffSeconds must be positive")
+    if cfg.pod_max_backoff_seconds < cfg.pod_initial_backoff_seconds:
+        errors.append(
+            "podMaxBackoffSeconds must be >= podInitialBackoffSeconds"
+        )
+
+    le = cfg.leader_election
+    if le.leader_elect:
+        if le.lease_duration_seconds <= 0:
+            errors.append("leaderElection.leaseDuration must be positive")
+        if le.renew_deadline_seconds <= 0:
+            errors.append("leaderElection.renewDeadline must be positive")
+        if le.retry_period_seconds <= 0:
+            errors.append("leaderElection.retryPeriod must be positive")
+        if le.renew_deadline_seconds > le.lease_duration_seconds:
+            errors.append(
+                "leaderElection.renewDeadline must be <= leaseDuration"
+            )
+        if not le.resource_name:
+            errors.append("leaderElection.resourceName is required")
+
+    # profiles: unique scheduler names; all share one queue sort
+    # (profile.go:120 validation)
+    names = [p.scheduler_name for p in cfg.profiles]
+    if len(set(names)) != len(names):
+        errors.append("profile schedulerNames must be unique")
+    queue_sorts = set()
+    for prof in cfg.profiles:
+        if not prof.scheduler_name:
+            errors.append("profile schedulerName must not be empty")
+        if prof.plugins is not None:
+            qs = tuple(
+                p.name for p in prof.plugins.queue_sort.enabled
+            )
+            if qs:
+                queue_sorts.add(qs)
+            errors.extend(_validate_plugins(prof.scheduler_name, prof.plugins))
+    if len(queue_sorts) > 1:
+        errors.append("all profiles must use the same queueSort plugins")
+
+    for i, ext in enumerate(getattr(cfg, "extenders", [])):
+        if not ext.url_prefix:
+            errors.append(f"extenders[{i}].urlPrefix is required")
+        if ext.weight <= 0 and ext.prioritize_verb:
+            errors.append(f"extenders[{i}].weight must be positive")
+        if ext.http_timeout_seconds <= 0:
+            errors.append(f"extenders[{i}].httpTimeout must be positive")
+    binders = sum(
+        1 for ext in getattr(cfg, "extenders", []) if ext.bind_verb
+    )
+    if binders > 1:
+        errors.append("only one extender may implement bind")
+
+    ts = cfg.tpu_solver
+    if ts.solver_mode not in ("greedy", "sinkhorn"):
+        errors.append(
+            f"tpuSolver.solverMode must be greedy|sinkhorn, got "
+            f"{ts.solver_mode!r}"
+        )
+    if ts.max_batch <= 0:
+        errors.append("tpuSolver.maxBatch must be positive")
+    if ts.batch_window_seconds < 0:
+        errors.append("tpuSolver.batchWindow must be >= 0")
+    if ts.mesh_devices < 0:
+        errors.append("tpuSolver.meshDevices must be >= 0")
+    return errors
+
+
+def _validate_plugins(profile: str, plugins: Plugins) -> List[str]:
+    errors: List[str] = []
+    for point in Plugins.EXTENSION_POINTS:
+        ps = getattr(plugins, point)
+        for p in ps.enabled:
+            if not p.name:
+                errors.append(f"profile {profile}: {point} plugin without name")
+            if point == "score" and not 1 <= p.weight <= MAX_WEIGHT:
+                errors.append(
+                    f"profile {profile}: score plugin {p.name} weight "
+                    f"{p.weight} outside [1, {MAX_WEIGHT}]"
+                )
+    return errors

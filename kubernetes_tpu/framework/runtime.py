@@ -63,6 +63,7 @@ class Framework:
         informers: Any = None,
         run_all_filters: bool = False,
         metrics_recorder: Any = None,
+        recorder: Any = None,
     ) -> None:
         self.registry = registry
         self.plugins_config = plugins
@@ -72,6 +73,13 @@ class Framework:
         self.run_all_filters = run_all_filters
         self.waiting_pods = WaitingPodsMap()
         self.metrics_recorder = metrics_recorder
+        # profile-scoped API event recorder (profile.go:39); a null
+        # recorder keeps unit tests wiring-free
+        if recorder is None:
+            from kubernetes_tpu.utils.event_recorder import NullRecorder
+
+            recorder = NullRecorder()
+        self.recorder = recorder
 
         plugin_config = plugin_config or {}
         needed = {p.name for point in Plugins.EXTENSION_POINTS

@@ -1,15 +1,19 @@
 """Vectorized feasibility masks (the Filter extension point, tensorized).
 
-Reference semantics: noderesources/fit.go:181 fitsRequest -- a node fails
-when any requested dimension exceeds ``allocatable - requested``; zero
-requested dimensions are never checked (so an already-overcommitted node
-still accepts zero-request pods), and the pod-count dimension is always
-checked (every pod "requests" one pod slot).
+Reference semantics: noderesources/fit.go:181 fitsRequest. This is the
+batched [B, N] form of the solver's per-step ``_fits``
+(ops/assignment.py) and shares it, so the exact zero-request semantics
+(only scalar/extended dimensions skip when unrequested; fixed dimensions
+check strictly; an all-zero request still checks the pod-count slot)
+stay in ONE place.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+
+from kubernetes_tpu.ops.assignment import _fits
 
 
 def fit_mask(
@@ -19,7 +23,6 @@ def fit_mask(
     valid: jnp.ndarray,  # [N] bool
 ) -> jnp.ndarray:
     """[B, N] bool: True where the pod fits the node's free resources."""
-    free = (allocatable - requested)[None, :, :]  # [1, N, R]
-    req = pod_requests[:, None, :]  # [B, 1, R]
-    ok = (req <= free) | (req == 0)
-    return ok.all(axis=-1) & valid[None, :]
+    free = allocatable - requested  # [N, R]
+    per_pod = jax.vmap(lambda req: _fits(free, req))(pod_requests)
+    return per_pod & valid[None, :]

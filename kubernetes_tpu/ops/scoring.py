@@ -120,14 +120,31 @@ def cluster_has_affinity_scoring(snapshot: Snapshot) -> bool:
     return False
 
 
-def batch_score_dynamic(pods: List[Pod], informers) -> bool:
+def batch_has_scoring_terms(pods: List[Pod]) -> bool:
+    """True when placing any of these pods makes it a symmetric scorer
+    for later pods (preferred terms, or required affinity terms via
+    hardPodAffinityWeight) -- an in-flight batch with such pods must
+    land before a later batch packs its ipa tensors."""
+    return any(
+        _preferred_aff_terms(p)
+        or _preferred_anti_terms(p)
+        or _required_aff_terms(p)
+        for p in pods
+    )
+
+
+def batch_score_dynamic(
+    pods: List[Pod], informers, ipa_weight: int = 1
+) -> bool:
     """True when the batch's scoring depends on host pod-placement state
     (selector spread, soft topology spread, or preferred inter-pod
     affinity) -- the dispatch pipeline must drain in-flight batches
-    BEFORE packing such batches."""
+    BEFORE packing such batches. ``ipa_weight`` gates the
+    preferred-affinity check on the profile actually scoring with
+    InterPodAffinity."""
     if any(_soft_constraints(p) for p in pods):
         return True
-    if any(
+    if ipa_weight and any(
         _preferred_aff_terms(p) or _preferred_anti_terms(p) for p in pods
     ):
         return True

@@ -116,6 +116,11 @@ class SchedulerApp:
     def start(self) -> None:
         self.informers.start()
         self.informers.wait_for_cache_sync()
+        # Freeze the synced cluster graph out of cyclic-GC scanning
+        # (utils/gc_tuning.py rationale).
+        from kubernetes_tpu.utils.gc_tuning import freeze_steady_state_graph
+
+        freeze_steady_state_graph()
         if self.config.leader_election.leader_elect:
             self.elector = LeaderElector(
                 self.client,

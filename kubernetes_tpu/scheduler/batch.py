@@ -57,6 +57,7 @@ from kubernetes_tpu.ops.affinity import (
 from kubernetes_tpu.ops.host_masks import static_mask_compact
 from kubernetes_tpu.ops.scoring import (
     ScoreEnvelopeExceeded,
+    batch_has_scoring_terms,
     batch_score_dynamic,
     cluster_has_affinity_scoring,
     noop_score_tensors,
@@ -386,6 +387,10 @@ class BatchScheduler(Scheduler):
         with self._pending_cv:
             return any(p.get("has_required_anti") for p in self._pending_q)
 
+    def _pending_has_scoring_terms(self) -> bool:
+        with self._pending_cv:
+            return any(p.get("has_scoring_terms") for p in self._pending_q)
+
     def _ensure_committer(self) -> None:
         if self._committer is None:
             self._committer_stop = False
@@ -504,24 +509,52 @@ class BatchScheduler(Scheduler):
         has_affinity = batch_has_affinity(pods)
         has_required_anti = batch_has_required_anti_affinity(pods)
         prof0 = self.profiles.get(pods[0].spec.scheduler_name)
+        # gated on the profile actually scoring with InterPodAffinity --
+        # otherwise the ipa family packs nothing and draining for it
+        # would serialize the pipeline for free
+        ipa_weight = (
+            prof0.score_plugin_weights().get("InterPodAffinity", 0)
+            if prof0 is not None
+            else 0
+        )
         score_dynamic = batch_score_dynamic(
-            pods, prof0.informers if prof0 is not None else None
+            pods,
+            prof0.informers if prof0 is not None else None,
+            ipa_weight=ipa_weight,
+        )
+        # this batch's pods become symmetric scorers for later batches
+        # once placed (preferred terms, and required affinity terms via
+        # hardPodAffinityWeight)
+        has_scoring_terms = bool(ipa_weight) and batch_has_scoring_terms(
+            pods
         )
         nominated_by_node = self.queue.all_nominated_pods_by_node()
-        if self._pending_exists() and (
-            has_hard_spread or has_affinity or score_dynamic
-            or nominated_by_node
-            # an in-flight batch carrying required anti-affinity imposes
-            # symmetric constraints this batch can only see once its
-            # placements are committed to the host cache
-            or self._pending_has_required_anti()
-        ):
+
+        def drained(reason_predicate: bool) -> bool:
+            """Land every in-flight batch when the predicate holds, then
+            rebuild the drain-sensitive inputs (nominee overlay source;
+            callers refresh the snapshot themselves when they hold one).
+            Returns True when a drain happened."""
+            nonlocal nominated_by_node
+            if not reason_predicate or not self._pending_exists():
+                return False
             self.pipeline_drains += 1
             self._drain_pending()
             # the drain can assume previously nominated pods (dropping
             # their nomination) and nominate new ones via preemption --
             # rebuild the overlay source from the post-drain state
             nominated_by_node = self.queue.all_nominated_pods_by_node()
+            return True
+
+        drained(
+            has_hard_spread or has_affinity or score_dynamic
+            or bool(nominated_by_node)
+            # an in-flight batch carrying required anti-affinity or
+            # scoring-relevant terms imposes symmetric constraints this
+            # batch can only see once its placements are committed
+            or self._pending_has_required_anti()
+            or self._pending_has_scoring_terms()
+        )
 
         snapshot = self.algorithm.snapshot
         self.cache.update_snapshot(snapshot)
@@ -531,31 +564,19 @@ class BatchScheduler(Scheduler):
         # their counts must include any in-flight placements
         if not has_affinity and cluster_has_required_anti_affinity(snapshot):
             has_affinity = True
-            if self._pending_exists():
-                self.pipeline_drains += 1
-                self._drain_pending()
+            if drained(True):
                 self.cache.update_snapshot(snapshot)
-                nominated_by_node = self.queue.all_nominated_pods_by_node()
         # existing pods with symmetric scoring terms make EVERY batch's
         # preferred-affinity family live (scoring.go:111): the in-flight
-        # counts must land before packing. Gated on the profile actually
-        # scoring with InterPodAffinity -- otherwise the family packs
-        # nothing and the drain would serialize the pipeline for free.
-        ipa_weight = (
-            prof0.score_plugin_weights().get("InterPodAffinity", 0)
-            if prof0 is not None
-            else 0
-        )
+        # counts must land before packing
         cluster_ipa = bool(ipa_weight) and cluster_has_affinity_scoring(
             snapshot
         )
         if not score_dynamic and cluster_ipa:
             score_dynamic = True
-            if self._pending_exists():
-                self.pipeline_drains += 1
-                self._drain_pending()
+            if drained(True):
                 self.cache.update_snapshot(snapshot)
-                nominated_by_node = self.queue.all_nominated_pods_by_node()
+                cluster_ipa = cluster_has_affinity_scoring(snapshot)
         nt = self.tensor_cache.update(snapshot)
         batch = pack_pod_batch(
             pods, nt.dims, timestamps=[pi.timestamp for pi in solver_infos]
@@ -765,6 +786,7 @@ class BatchScheduler(Scheduler):
             return {
                 "solver_infos": list(solver_infos),
                 "has_required_anti": has_required_anti,
+                "has_scoring_terms": has_scoring_terms,
                 "order": order,
                 "assignments_dev": assignments_dev,
                 "req": req,
@@ -878,6 +900,7 @@ class BatchScheduler(Scheduler):
             # copy: the caller's list is cleared after dispatch returns
             "solver_infos": list(solver_infos),
             "has_required_anti": has_required_anti,
+            "has_scoring_terms": has_scoring_terms,
             "order": order,
             "assignments_dev": assignments_dev,
             "req": req,
@@ -1193,6 +1216,27 @@ class BatchScheduler(Scheduler):
         if prof0.has_plugins("post_bind"):
             for prof, state, pi, assumed, host in bound:
                 prof.run_post_bind_plugins(state, assumed, host)
+        recorder = prof0.recorder
+        if hasattr(recorder, "eventf_many"):
+            recorder.eventf_many(
+                [
+                    (
+                        assumed, "Normal", "Scheduled",
+                        f"Successfully assigned "
+                        f"{assumed.metadata.namespace}/"
+                        f"{assumed.metadata.name} to {host}",
+                    )
+                    for _, _, _, assumed, host in bound
+                ]
+            )
+        else:
+            for prof, state, pi, assumed, host in bound:
+                prof.recorder.eventf(
+                    assumed, "Normal", "Scheduled",
+                    f"Successfully assigned "
+                    f"{assumed.metadata.namespace}/"
+                    f"{assumed.metadata.name} to {host}",
+                )
         # batched success metrics (one lock hold per histogram)
         metrics.schedule_attempts.inc(len(bound), result="scheduled")
         metrics.pod_scheduling_attempts.observe_many(

@@ -562,6 +562,13 @@ class Preemptor:
                 self.queue.delete_nominated_pod_if_exists(pod)
                 return False
         for victim in victims:
+            recorder = getattr(prof, "recorder", None)
+            if recorder is not None:
+                recorder.eventf(
+                    victim, "Normal", "Preempted",
+                    f"Preempted by {pod.metadata.namespace}/"
+                    f"{pod.metadata.name} on node {node_name}",
+                )
             if self.client is not None:
                 try:
                     self.client.delete_pod(

@@ -1,0 +1,118 @@
+"""API event recorder: Scheduled / FailedScheduling / Preempted Event
+objects stored and listable via the apiserver (reference profile.go:39
+Recorder; scheduler.go:378, :544).
+"""
+
+import time
+
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _cluster(max_batch=16):
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(client, informers, batch=True, max_batch=max_batch)
+    return server, client, informers, sched
+
+
+def _events_by_reason(client, reason):
+    events, _ = client.list_events()
+    return [e for e in events if e.reason == reason]
+
+
+def test_scheduled_and_failed_events():
+    server, client, informers, sched = _cluster()
+    client.create_node(make_node("n").capacity(cpu="2", memory="4Gi").obj())
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    client.create_pod(make_pod("fits").container(cpu="1").obj())
+    client.create_pod(make_pod("toobig").container(cpu="64").obj())
+    sched.start()
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        sched.event_broadcaster.flush()
+        if _events_by_reason(client, "Scheduled") and _events_by_reason(
+            client, "FailedScheduling"
+        ):
+            break
+        time.sleep(0.05)
+    sched.stop()
+    informers.stop()
+
+    scheduled = _events_by_reason(client, "Scheduled")
+    assert scheduled, "no Scheduled event recorded"
+    ev = scheduled[0]
+    assert ev.involved_object.name == "fits"
+    assert ev.type == "Normal"
+    assert ev.source == "default-scheduler"
+    assert "Successfully assigned default/fits to n" in ev.message
+
+    failed = _events_by_reason(client, "FailedScheduling")
+    assert failed, "no FailedScheduling event recorded"
+    assert failed[0].involved_object.name == "toobig"
+    assert failed[0].type == "Warning"
+
+
+def test_failed_scheduling_aggregates_count():
+    server, client, informers, sched = _cluster()
+    client.create_node(make_node("n").capacity(cpu="1", memory="1Gi").obj())
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    client.create_pod(make_pod("big").container(cpu="64").obj())
+    sched.start()
+    deadline = time.time() + 20
+    count = 0
+    while time.time() < deadline:
+        # repeated retries (backoff flush) re-fail the same pod
+        sched.queue.move_all_to_active_or_backoff_queue("test")
+        sched.event_broadcaster.flush()
+        failed = _events_by_reason(client, "FailedScheduling")
+        if failed and failed[0].count >= 2:
+            count = failed[0].count
+            break
+        time.sleep(0.1)
+    sched.stop()
+    informers.stop()
+    assert count >= 2
+    # aggregation: repeats bumped count instead of new objects
+    assert len(_events_by_reason(client, "FailedScheduling")) == 1
+
+
+def test_preempted_event_on_victim():
+    server, client, informers, sched = _cluster()
+    client.create_node(make_node("n").capacity(cpu="2", memory="4Gi").obj())
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    client.create_pod(
+        make_pod("victim").container(cpu="2").priority(0).obj()
+    )
+    sched.start()
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        pods, _ = client.list_pods()
+        if any(p.spec.node_name for p in pods):
+            break
+        time.sleep(0.05)
+    client.create_pod(
+        make_pod("high").container(cpu="2").priority(100).obj()
+    )
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        sched.event_broadcaster.flush()
+        if _events_by_reason(client, "Preempted"):
+            break
+        time.sleep(0.05)
+    sched.stop()
+    informers.stop()
+    preempted = _events_by_reason(client, "Preempted")
+    assert preempted, "no Preempted event recorded"
+    assert preempted[0].involved_object.name == "victim"
+    assert "Preempted by default/high on node n" in preempted[0].message
