@@ -19,6 +19,35 @@ from kubernetes_tpu.api.types import (
     NodeSelectorTerm,
 )
 
+try:  # native matcher (SURVEY section 2.4 host data plane)
+    from kubernetes_tpu.native import hotpath as _native
+except Exception:  # noqa: BLE001 - pure-Python fallback
+    _native = None
+
+_OP_CODES = {"In": 0, "NotIn": 1, "Exists": 2, "DoesNotExist": 3}
+
+
+def compile_selector(selector: LabelSelector):
+    """Pre-compiled form for the native matcher, cached on the selector
+    object (selectors are immutable once built, the same contract as
+    every informer-cached object). Unknown operators raise ValueError at
+    compile time -- the same exception the Python path raises at match
+    time."""
+    c = selector.__dict__.get("_compiled")
+    if c is None:
+        try:
+            exprs = tuple(
+                (r.key, _OP_CODES[r.operator], frozenset(r.values))
+                for r in selector.match_expressions
+            )
+        except KeyError as e:
+            raise ValueError(
+                f"unknown label selector operator {e.args[0]!r}"
+            ) from None
+        c = (selector.match_labels, exprs)
+        selector.__dict__["_compiled"] = c
+    return c
+
 
 def _match_requirement(labels: Dict[str, str], req: LabelSelectorRequirement) -> bool:
     op = req.operator
@@ -33,12 +62,11 @@ def _match_requirement(labels: Dict[str, str], req: LabelSelectorRequirement) ->
     raise ValueError(f"unknown label selector operator {op!r}")
 
 
-def labels_match_selector(
+def labels_match_selector_py(
     labels: Dict[str, str], selector: Optional[LabelSelector]
 ) -> bool:
-    """True if ``labels`` match ``selector``. A nil selector matches nothing
-    (reference metav1.LabelSelectorAsSelector returns labels.Nothing() for
-    nil); an empty selector matches everything."""
+    """Pure-Python reference implementation (the native module's
+    differential oracle)."""
     if selector is None:
         return False
     for k, v in selector.match_labels.items():
@@ -50,10 +78,38 @@ def labels_match_selector(
     return True
 
 
+def labels_match_selector(
+    labels: Dict[str, str], selector: Optional[LabelSelector]
+) -> bool:
+    """True if ``labels`` match ``selector``. A nil selector matches nothing
+    (reference metav1.LabelSelectorAsSelector returns labels.Nothing() for
+    nil); an empty selector matches everything."""
+    if selector is None:
+        return False
+    if _native is not None:
+        return _native.match_compiled(labels, compile_selector(selector))
+    return labels_match_selector_py(labels, selector)
+
+
+def labels_match_mask(
+    labels_list: List[Dict[str, str]], selector: LabelSelector
+) -> bytes:
+    """One byte (0/1) per labels dict -- the packers' inner loop over
+    many pods against one selector, native when available."""
+    if _native is not None:
+        return _native.match_mask(labels_list, compile_selector(selector))
+    return bytes(
+        1 if labels_match_selector_py(labels, selector) else 0
+        for labels in labels_list
+    )
+
+
 def label_selector_as_dict_matches(
     selector_labels: Dict[str, str], labels: Dict[str, str]
 ) -> bool:
     """Plain map-selector match (services/RCs): every selector kv present."""
+    if _native is not None:
+        return _native.dict_covers(labels, selector_labels)
     if not selector_labels:
         return False
     return all(labels.get(k) == v for k, v in selector_labels.items())

@@ -8,5 +8,6 @@ maintains PDB.Status.DisruptionsAllowed, the budget preemption spends
 """
 
 from kubernetes_tpu.controllers.disruption import DisruptionController
+from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 
-__all__ = ["DisruptionController"]
+__all__ = ["DisruptionController", "NodeLifecycleController"]

@@ -96,13 +96,20 @@ class DisruptionController:
         pdb = self._pdbs.get(namespace, name)
         if pdb is None:
             return
-        matching = [
-            p
-            for p in self._pods.list()
-            if p.metadata.namespace == namespace
-            and pdb.selector is not None
-            and labels_match_selector(p.metadata.labels, pdb.selector)
-        ]
+        if pdb.selector is None:
+            matching = []
+        else:
+            from kubernetes_tpu.api.selectors import labels_match_mask
+
+            candidates = [
+                p
+                for p in self._pods.list()
+                if p.metadata.namespace == namespace
+            ]
+            mask = labels_match_mask(
+                [p.metadata.labels for p in candidates], pdb.selector
+            )
+            matching = [p for p, bit in zip(candidates, mask) if bit]
         expected = len(matching)
         healthy = sum(
             1

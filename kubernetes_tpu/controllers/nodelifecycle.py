@@ -1,0 +1,154 @@
+"""Node lifecycle controller: heartbeat-driven failure detection.
+
+Reference: pkg/controller/nodelifecycle/node_lifecycle_controller.go
+(:171 monitors NodeStatus + coordination Leases, :303/:324 marks stale
+nodes NotReady and applies NoExecute taints) plus the NoExecute taint
+manager's eviction of intolerant pods. The scheduler side needs no
+changes: its TaintToleration filter already keeps new pods off tainted
+nodes, and the eviction deletes wake parked pods via the normal
+informer paths.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from kubernetes_tpu.api.types import (
+    Node,
+    NodeCondition,
+    TAINT_EFFECT_NO_EXECUTE,
+    Taint,
+)
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.kubelet.hollow import LEASE_NAMESPACE
+
+logger = logging.getLogger(__name__)
+
+TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
+
+
+class NodeLifecycleController:
+    def __init__(
+        self,
+        client,
+        informer_factory: InformerFactory,
+        grace_period: float = 40.0,
+        monitor_interval: float = 5.0,
+        now=time.time,
+    ) -> None:
+        self.client = client
+        self._nodes = informer_factory.nodes()
+        self._pods = informer_factory.pods()
+        self.grace_period = grace_period
+        self.monitor_interval = monitor_interval
+        self._now = now
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.evictions = 0
+
+    # -- one monitor pass (monitorNodeHealth, :303) --------------------------
+
+    def monitor_once(self) -> None:
+        now = self._now()
+        for node in self._nodes.list():
+            name = node.metadata.name
+            lease = self._lease(name)
+            fresh = (
+                lease is not None
+                and now - lease.renew_time <= self.grace_period
+            )
+            tainted = any(
+                t.key == TAINT_UNREACHABLE for t in node.spec.taints
+            )
+            if fresh and tainted:
+                self._untaint(name)
+            elif not fresh and not tainted and lease is not None:
+                # had a heartbeat once, lost it: unreachable
+                self._mark_unreachable(name)
+                self._evict_intolerant_pods(name)
+
+    def _lease(self, name: str):
+        try:
+            return self.client.server.get("Lease", LEASE_NAMESPACE, name)
+        except KeyError:
+            return None
+
+    def _mark_unreachable(self, name: str) -> None:
+        def mutate(node: Node) -> None:
+            # dedup inside the mutate closure: guaranteed_update has
+            # refetched the authoritative object, so a stale informer
+            # view in monitor_once can't stack duplicate taints
+            if any(t.key == TAINT_UNREACHABLE for t in node.spec.taints):
+                return
+            node.spec.taints = list(node.spec.taints) + [
+                Taint(
+                    key=TAINT_UNREACHABLE,
+                    effect=TAINT_EFFECT_NO_EXECUTE,
+                )
+            ]
+            node.status.conditions = [
+                c for c in node.status.conditions if c.type != "Ready"
+            ] + [NodeCondition(type="Ready", status="Unknown")]
+
+        try:
+            self.client.server.guaranteed_update("Node", "", name, mutate)
+            logger.warning("node %s marked unreachable (stale lease)", name)
+        except KeyError:
+            pass
+
+    def _untaint(self, name: str) -> None:
+        def mutate(node: Node) -> None:
+            node.spec.taints = [
+                t for t in node.spec.taints if t.key != TAINT_UNREACHABLE
+            ]
+
+        try:
+            self.client.server.guaranteed_update("Node", "", name, mutate)
+        except KeyError:
+            pass
+
+    def _evict_intolerant_pods(self, node_name: str) -> None:
+        """NoExecute semantics: pods without a matching toleration are
+        evicted (the NoExecuteTaintManager, zero toleration-seconds
+        model)."""
+        taint = Taint(key=TAINT_UNREACHABLE, effect=TAINT_EFFECT_NO_EXECUTE)
+        for pod in self._pods.list():
+            if pod.spec.node_name != node_name:
+                continue
+            if any(t.tolerates(taint) for t in pod.spec.tolerations):
+                continue
+            try:
+                self.client.delete_pod(
+                    pod.metadata.namespace, pod.metadata.name
+                )
+                self.evictions += 1
+            except KeyError:
+                pass
+            except Exception:
+                logger.exception("evicting pod %s", pod.key())
+
+    # -- loop ----------------------------------------------------------------
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.monitor_once()
+            except Exception:
+                logger.exception("node lifecycle monitor")
+            self._stop.wait(self.monitor_interval)
+
+    def start(self) -> threading.Thread:
+        self._thread = threading.Thread(
+            target=self.run, name="nodelifecycle", daemon=True
+        )
+        self._thread.start()
+        return self._thread
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

@@ -1,0 +1,62 @@
+"""Native host data plane (SURVEY.md section 2.4).
+
+The C extension (_hotpath.c) is compiled ON FIRST IMPORT with the
+toolchain baked into the image (g++ against the running interpreter's
+headers -- no pip, no pybind11). A build or import failure degrades
+silently to the pure-Python implementations in api/selectors.py, which
+carry identical semantics (differentially fuzzed in
+tests/test_native_selectors.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sysconfig
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "_hotpath.c")
+_SO = os.path.join(
+    _DIR, "_hotpath" + (sysconfig.get_config_var("EXT_SUFFIX") or ".so")
+)
+
+
+def _build() -> bool:
+    include = sysconfig.get_paths()["include"]
+    for cc in ("g++", "cc", "gcc"):
+        try:
+            subprocess.run(
+                [
+                    cc, "-O2", "-shared", "-fPIC", "-x", "c",
+                    f"-I{include}", _SRC, "-o", _SO,
+                ],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            return True
+        except FileNotFoundError:
+            continue
+        except Exception as e:  # noqa: BLE001 - degrade to Python
+            logger.debug("native build with %s failed: %s", cc, e)
+            return False
+    return False
+
+
+hotpath = None
+try:
+    if not os.path.exists(_SO) or (
+        os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+    ):
+        _build()
+    # gate the import on the binary being CURRENT: importing a stale .so
+    # after a failed rebuild would silently run old matching semantics
+    if os.path.exists(_SO) and (
+        os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+    ):
+        from kubernetes_tpu.native import _hotpath as hotpath  # type: ignore
+except Exception:  # noqa: BLE001 - pure-Python fallback
+    hotpath = None

@@ -1,0 +1,194 @@
+/* Native host data plane: the hot label-selector matcher.
+ *
+ * SURVEY.md section 2.4: the reference has no native scheduling code (all
+ * Go); the native components owed here are the NEW performance core. On
+ * the host side the single hottest string operation is label-selector
+ * matching -- every pack family (affinity/spread/selector-spread/
+ * preferred-affinity count tensors), PDB budget filtering, the disruption
+ * controller, and the affinity queue wakeups all reduce to
+ * labels_match_selector() over (pod labels, selector) pairs, O(pods x
+ * rows) per batch. This module implements the match against a
+ * PRE-COMPILED selector form (built once per selector object by
+ * kubernetes_tpu/api/selectors.py):
+ *
+ *   compiled = (match_labels_dict,
+ *               ((key, opcode, values_frozenset), ...))
+ *   opcodes: 0=In 1=NotIn 2=Exists 3=DoesNotExist
+ *
+ * Exposed functions:
+ *   match_compiled(labels_dict, compiled) -> bool
+ *   match_mask(labels_list, compiled) -> bytes   (one byte per entry;
+ *       the packers' inner loops over many pods per selector)
+ *   dict_covers(labels_dict, selector_dict) -> bool  (plain map
+ *       selectors: every kv present; empty selector -> False, matching
+ *       label_selector_as_dict_matches)
+ *
+ * Python fallbacks with identical semantics live in api/selectors.py;
+ * tests/test_native_selectors.py differentially fuzzes the two.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+static int
+match_compiled_impl(PyObject *labels, PyObject *compiled)
+{
+    /* returns 1 match, 0 no match, -1 error */
+    PyObject *ml = PyTuple_GET_ITEM(compiled, 0);   /* dict */
+    PyObject *exprs = PyTuple_GET_ITEM(compiled, 1); /* tuple */
+
+    PyObject *key, *value;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(ml, &pos, &key, &value)) {
+        PyObject *got = PyDict_GetItemWithError(labels, key);
+        if (got == NULL) {
+            if (PyErr_Occurred())
+                return -1;
+            return 0;
+        }
+        int eq = PyObject_RichCompareBool(got, value, Py_EQ);
+        if (eq < 0)
+            return -1;
+        if (!eq)
+            return 0;
+    }
+
+    Py_ssize_t n = PyTuple_GET_SIZE(exprs);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *req = PyTuple_GET_ITEM(exprs, i);
+        PyObject *rkey = PyTuple_GET_ITEM(req, 0);
+        long op = PyLong_AsLong(PyTuple_GET_ITEM(req, 1));
+        PyObject *values = PyTuple_GET_ITEM(req, 2);
+        PyObject *got = PyDict_GetItemWithError(labels, rkey);
+        if (got == NULL && PyErr_Occurred())
+            return -1;
+        int ok;
+        switch (op) {
+        case 0: /* In */
+            if (got == NULL) {
+                ok = 0;
+            } else {
+                ok = PySet_Contains(values, got);
+                if (ok < 0)
+                    return -1;
+            }
+            break;
+        case 1: /* NotIn */
+            if (got == NULL) {
+                ok = 1;
+            } else {
+                int in = PySet_Contains(values, got);
+                if (in < 0)
+                    return -1;
+                ok = !in;
+            }
+            break;
+        case 2: /* Exists */
+            ok = got != NULL;
+            break;
+        case 3: /* DoesNotExist */
+            ok = got == NULL;
+            break;
+        default:
+            PyErr_SetString(PyExc_ValueError,
+                            "unknown label selector opcode");
+            return -1;
+        }
+        if (!ok)
+            return 0;
+    }
+    return 1;
+}
+
+static PyObject *
+match_compiled(PyObject *self, PyObject *args)
+{
+    PyObject *labels, *compiled;
+    if (!PyArg_ParseTuple(args, "O!O!", &PyDict_Type, &labels,
+                          &PyTuple_Type, &compiled))
+        return NULL;
+    int r = match_compiled_impl(labels, compiled);
+    if (r < 0)
+        return NULL;
+    if (r)
+        Py_RETURN_TRUE;
+    Py_RETURN_FALSE;
+}
+
+static PyObject *
+match_mask(PyObject *self, PyObject *args)
+{
+    PyObject *labels_list, *compiled;
+    if (!PyArg_ParseTuple(args, "O!O!", &PyList_Type, &labels_list,
+                          &PyTuple_Type, &compiled))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(labels_list);
+    PyObject *out = PyBytes_FromStringAndSize(NULL, n);
+    if (out == NULL)
+        return NULL;
+    char *buf = PyBytes_AS_STRING(out);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *labels = PyList_GET_ITEM(labels_list, i);
+        if (!PyDict_Check(labels)) {
+            Py_DECREF(out);
+            PyErr_SetString(PyExc_TypeError, "labels entries must be dicts");
+            return NULL;
+        }
+        int r = match_compiled_impl(labels, compiled);
+        if (r < 0) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        buf[i] = (char)r;
+    }
+    return out;
+}
+
+static PyObject *
+dict_covers(PyObject *self, PyObject *args)
+{
+    PyObject *labels, *selector;
+    if (!PyArg_ParseTuple(args, "O!O!", &PyDict_Type, &labels,
+                          &PyDict_Type, &selector))
+        return NULL;
+    if (PyDict_GET_SIZE(selector) == 0)
+        Py_RETURN_FALSE; /* empty map selector matches nothing */
+    PyObject *key, *value;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(selector, &pos, &key, &value)) {
+        PyObject *got = PyDict_GetItemWithError(labels, key);
+        if (got == NULL) {
+            if (PyErr_Occurred())
+                return NULL;
+            Py_RETURN_FALSE;
+        }
+        int eq = PyObject_RichCompareBool(got, value, Py_EQ);
+        if (eq < 0)
+            return NULL;
+        if (!eq)
+            Py_RETURN_FALSE;
+    }
+    Py_RETURN_TRUE;
+}
+
+static PyMethodDef methods[] = {
+    {"match_compiled", match_compiled, METH_VARARGS,
+     "match_compiled(labels, compiled) -> bool"},
+    {"match_mask", match_mask, METH_VARARGS,
+     "match_mask(labels_list, compiled) -> bytes"},
+    {"dict_covers", dict_covers, METH_VARARGS,
+     "dict_covers(labels, selector_dict) -> bool"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_hotpath",
+    "native label-selector matching (SURVEY section 2.4 host data plane)",
+    -1, methods,
+};
+
+PyMODINIT_FUNC
+PyInit__hotpath(void)
+{
+    return PyModule_Create(&moduledef);
+}

@@ -104,6 +104,8 @@ def pack_preemption_state(
 
     from kubernetes_tpu.tensors import pack_pod_batch
 
+    from kubernetes_tpu.api.selectors import labels_match_mask
+
     for i, (ni, pods) in enumerate(zip(node_infos, sorted_pods)):
         row = nt.row(ni.node_name)
         alloc[i] = nt.allocatable[row]
@@ -116,14 +118,18 @@ def pack_preemption_state(
                 st = p.status.start_time
                 start_rel[i, v] = st if st is not None else now
                 active[i, v] = True
-                for k, pdb in enumerate(pdbs):
+            # PDB match columns via the native bulk matcher (one call
+            # per (node, pdb) over the node's pod labels)
+            labels_list = [p.metadata.labels for p in pods]
+            for k, pdb in enumerate(pdbs):
+                if pdb.selector is None:
+                    continue
+                mask = labels_match_mask(labels_list, pdb.selector)
+                for v, p in enumerate(pods):
                     if (
-                        pdb.metadata.namespace == p.metadata.namespace
-                        and pdb.selector is not None
+                        mask[v]
                         and p.metadata.labels
-                        and labels_match_selector(
-                            p.metadata.labels, pdb.selector
-                        )
+                        and pdb.metadata.namespace == p.metadata.namespace
                     ):
                         pdb_match[i, v, k] = True
 

@@ -1,0 +1,101 @@
+"""Native label matcher (kubernetes_tpu/native/_hotpath.c) vs the
+pure-Python reference implementation: randomized differential fuzzing.
+
+The native module is the SURVEY section 2.4 host data plane; semantics
+must be bit-identical to api/selectors.py's Python path.
+"""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.api.selectors import (
+    compile_selector,
+    label_selector_as_dict_matches,
+    labels_match_mask,
+    labels_match_selector,
+    labels_match_selector_py,
+)
+from kubernetes_tpu.api.types import LabelSelector, LabelSelectorRequirement
+from kubernetes_tpu.native import hotpath
+
+KEYS = ["app", "tier", "zone", "color", ""]
+VALUES = ["a", "b", "c", "", "x" * 64]
+OPS = ["In", "NotIn", "Exists", "DoesNotExist"]
+
+
+def _random_labels(rng):
+    return {
+        rng.choice(KEYS): rng.choice(VALUES)
+        for _ in range(rng.randrange(0, 4))
+    }
+
+
+def _random_selector(rng):
+    return LabelSelector(
+        match_labels=_random_labels(rng),
+        match_expressions=[
+            LabelSelectorRequirement(
+                key=rng.choice(KEYS),
+                operator=rng.choice(OPS),
+                values=[rng.choice(VALUES) for _ in range(rng.randrange(0, 3))],
+            )
+            for _ in range(rng.randrange(0, 3))
+        ],
+    )
+
+
+def test_native_module_built():
+    assert hotpath is not None, "native matcher failed to build"
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_differential_match(seed):
+    rng = random.Random(seed)
+    for _ in range(2000):
+        labels = _random_labels(rng)
+        selector = _random_selector(rng)
+        assert labels_match_selector(labels, selector) == (
+            labels_match_selector_py(labels, selector)
+        ), (labels, selector)
+
+
+def test_match_mask_agrees_with_scalar():
+    rng = random.Random(7)
+    selector = _random_selector(rng)
+    labels_list = [_random_labels(rng) for _ in range(500)]
+    mask = labels_match_mask(labels_list, selector)
+    for labels, bit in zip(labels_list, mask):
+        assert bool(bit) == labels_match_selector_py(labels, selector)
+
+
+def test_dict_covers_semantics():
+    assert not label_selector_as_dict_matches({}, {"a": "b"})  # empty: nothing
+    assert label_selector_as_dict_matches({"a": "b"}, {"a": "b", "c": "d"})
+    assert not label_selector_as_dict_matches({"a": "x"}, {"a": "b"})
+
+
+def test_nil_selector_matches_nothing():
+    assert not labels_match_selector({"a": "b"}, None)
+
+
+def test_empty_selector_matches_everything():
+    assert labels_match_selector({"a": "b"}, LabelSelector())
+    assert labels_match_selector({}, LabelSelector())
+
+
+def test_unknown_operator_raises():
+    sel = LabelSelector(
+        match_expressions=[
+            LabelSelectorRequirement(key="a", operator="Bogus", values=[])
+        ]
+    )
+    with pytest.raises(ValueError, match="unknown label selector operator"):
+        labels_match_selector({"a": "b"}, sel)
+    with pytest.raises(ValueError, match="unknown label selector operator"):
+        labels_match_selector_py({"a": "b"}, sel)
+
+
+def test_compile_cached_on_selector():
+    sel = LabelSelector(match_labels={"a": "b"})
+    assert compile_selector(sel) is compile_selector(sel)

@@ -1,0 +1,86 @@
+"""Node lifecycle controller: stale heartbeats taint the node NoExecute
+and evict intolerant pods; recovery removes the taint.
+
+Reference: pkg/controller/nodelifecycle/node_lifecycle_controller.go
+(:303 monitorNodeHealth, NoExecute taint manager eviction).
+"""
+
+from kubernetes_tpu.api.types import TAINT_EFFECT_NO_EXECUTE
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.controllers import NodeLifecycleController
+from kubernetes_tpu.controllers.nodelifecycle import TAINT_UNREACHABLE
+from kubernetes_tpu.kubelet import HollowKubelet
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _env():
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    clock = {"now": 1000.0}
+    ctrl = NodeLifecycleController(
+        client, informers, grace_period=40.0, now=lambda: clock["now"]
+    )
+    return server, client, informers, ctrl, clock
+
+
+def test_stale_lease_taints_and_evicts():
+    server, client, informers, ctrl, clock = _env()
+    client.create_node(make_node("n").capacity(cpu="4", memory="8Gi").obj())
+    client.create_pod(make_pod("victim").node("n").container(cpu="1").obj())
+    tolerant = (
+        make_pod("survivor").node("n").container(cpu="1")
+        .toleration(TAINT_UNREACHABLE, operator="Exists",
+                    effect=TAINT_EFFECT_NO_EXECUTE)
+        .obj()
+    )
+    client.create_pod(tolerant)
+    kubelet = HollowKubelet(client, "n", now=lambda: clock["now"])
+
+    # heartbeat at t=1000
+    kubelet.heartbeat_once()
+    informers.pods().pump()
+    informers.nodes().pump()
+
+    # fresh: nothing happens
+    ctrl.monitor_once()
+    node = client.get_node("n")
+    assert not any(t.key == TAINT_UNREACHABLE for t in node.spec.taints)
+
+    # lease goes stale
+    clock["now"] += 120.0
+    ctrl.monitor_once()
+    node = client.get_node("n")
+    assert any(
+        t.key == TAINT_UNREACHABLE and t.effect == TAINT_EFFECT_NO_EXECUTE
+        for t in node.spec.taints
+    )
+    assert any(
+        c.type == "Ready" and c.status == "Unknown"
+        for c in node.status.conditions
+    )
+    names = {p.metadata.name for p in client.list_pods()[0]}
+    assert "victim" not in names  # evicted
+    assert "survivor" in names  # tolerates NoExecute
+    assert ctrl.evictions == 1
+
+
+def test_recovered_heartbeat_untaints():
+    server, client, informers, ctrl, clock = _env()
+    client.create_node(make_node("n").capacity(cpu="4", memory="8Gi").obj())
+    kubelet = HollowKubelet(client, "n", now=lambda: clock["now"])
+    kubelet.heartbeat_once()
+    informers.nodes().pump()
+    clock["now"] += 120.0
+    ctrl.monitor_once()
+    informers.nodes().pump()
+    node = client.get_node("n")
+    assert any(t.key == TAINT_UNREACHABLE for t in node.spec.taints)
+    # heartbeat resumes
+    kubelet.heartbeat_once()
+    informers.nodes().pump()
+    ctrl.monitor_once()
+    node = client.get_node("n")
+    assert not any(t.key == TAINT_UNREACHABLE for t in node.spec.taints)
