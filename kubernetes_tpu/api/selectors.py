@@ -30,21 +30,19 @@ _OP_CODES = {"In": 0, "NotIn": 1, "Exists": 2, "DoesNotExist": 3}
 def compile_selector(selector: LabelSelector):
     """Pre-compiled form for the native matcher, cached on the selector
     object (selectors are immutable once built, the same contract as
-    every informer-cached object). Unknown operators raise ValueError at
-    compile time -- the same exception the Python path raises at match
-    time."""
+    every informer-cached object). Unknown operators compile to opcode
+    -1 so the C path raises ValueError only when evaluation REACHES the
+    bad requirement -- the exact short-circuit behavior of the Python
+    path."""
     c = selector.__dict__.get("_compiled")
     if c is None:
-        try:
-            exprs = tuple(
-                (r.key, _OP_CODES[r.operator], frozenset(r.values))
+        c = (
+            selector.match_labels,
+            tuple(
+                (r.key, _OP_CODES.get(r.operator, -1), frozenset(r.values))
                 for r in selector.match_expressions
-            )
-        except KeyError as e:
-            raise ValueError(
-                f"unknown label selector operator {e.args[0]!r}"
-            ) from None
-        c = (selector.match_labels, exprs)
+            ),
+        )
         selector.__dict__["_compiled"] = c
     return c
 

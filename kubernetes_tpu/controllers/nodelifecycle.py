@@ -65,9 +65,15 @@ class NodeLifecycleController:
             )
             if fresh and tainted:
                 self._untaint(name)
-            elif not fresh and not tainted and lease is not None:
-                # had a heartbeat once, lost it: unreachable
-                self._mark_unreachable(name)
+            elif not fresh and lease is not None:
+                # had a heartbeat once, lost it: unreachable. Eviction
+                # reconciles EVERY pass while the node stays stale (the
+                # NoExecute taint manager is continuous, not edge-
+                # triggered): pods that appear on the node later -- or
+                # that a lagging informer missed at transition time --
+                # still get evicted.
+                if not tainted:
+                    self._mark_unreachable(name)
                 self._evict_intolerant_pods(name)
 
     def _lease(self, name: str):

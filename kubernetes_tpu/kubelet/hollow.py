@@ -164,14 +164,25 @@ class HollowNodePool:
                     )
                 except Exception:
                     pass
-            # Ready condition on NodeStatus
+            # Ready condition on NodeStatus -- written only when it
+            # actually changes: the reference kubelet introduced Leases
+            # precisely so steady-state heartbeats don't rewrite the
+            # Node object (an unconditional write here would fan out
+            # O(nodes) MODIFIED events per interval into the scheduler's
+            # informer/cache/tensor-diff path)
             try:
-                def set_ready(node: Node) -> None:
-                    node.status.conditions = [
-                        c for c in node.status.conditions if c.type != "Ready"
-                    ] + [NodeCondition(type="Ready", status="True")]
+                node = server.get("Node", "", name)
+                if not any(
+                    c.type == "Ready" and c.status == "True"
+                    for c in node.status.conditions
+                ):
+                    def set_ready(n: Node) -> None:
+                        n.status.conditions = [
+                            c for c in n.status.conditions
+                            if c.type != "Ready"
+                        ] + [NodeCondition(type="Ready", status="True")]
 
-                server.guaranteed_update("Node", "", name, set_ready)
+                    server.guaranteed_update("Node", "", name, set_ready)
             except KeyError:
                 pass
 

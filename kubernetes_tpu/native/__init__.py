@@ -26,23 +26,33 @@ _SO = os.path.join(
 
 def _build() -> bool:
     include = sysconfig.get_paths()["include"]
+    tmp = _SO + f".build.{os.getpid()}"
     for cc in ("g++", "cc", "gcc"):
         try:
             subprocess.run(
                 [
                     cc, "-O2", "-shared", "-fPIC", "-x", "c",
-                    f"-I{include}", _SRC, "-o", _SO,
+                    f"-I{include}", _SRC, "-o", tmp,
                 ],
                 check=True,
                 capture_output=True,
                 timeout=120,
             )
+            # atomic publish: concurrent importers never dlopen a
+            # half-written binary
+            os.replace(tmp, _SO)
             return True
         except FileNotFoundError:
             continue
-        except Exception as e:  # noqa: BLE001 - degrade to Python
+        except Exception as e:  # noqa: BLE001 - try the next compiler
             logger.debug("native build with %s failed: %s", cc, e)
-            return False
+            continue
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
     return False
 
 

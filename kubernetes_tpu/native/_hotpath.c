@@ -90,8 +90,11 @@ match_compiled_impl(PyObject *labels, PyObject *compiled)
             ok = got == NULL;
             break;
         default:
+            /* opcode -1: an operator the compiler didn't recognize;
+             * raised only when evaluation reaches it, matching the
+             * Python path's short-circuit semantics */
             PyErr_SetString(PyExc_ValueError,
-                            "unknown label selector opcode");
+                            "unknown label selector operator");
             return -1;
         }
         if (!ok)

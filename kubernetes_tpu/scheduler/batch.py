@@ -198,6 +198,7 @@ class BatchScheduler(Scheduler):
         # envelope fallbacks were unmetered)
         self.envelope_fallbacks = 0  # whole batches sent to host by packers
         self.pipeline_drains = 0  # constrained dispatch drained the pipeline
+        self.nominee_constrained_fallbacks = 0  # nominees + constraints
         self.state_reuses = 0
         self.state_uploads = 0
         self._dev = _DeviceNodeState()
@@ -577,6 +578,36 @@ class BatchScheduler(Scheduler):
             if drained(True):
                 self.cache.update_snapshot(snapshot)
                 cluster_ipa = cluster_has_affinity_scoring(snapshot)
+        if nominated_by_node and (
+            has_hard_spread or has_affinity or score_dynamic
+            # a CONSTRAINED nominee (required (anti-)affinity / spread)
+            # imposes symmetric constraints the resource-only overlay
+            # can't express even for a plain batch
+            or any(
+                p.spec.affinity is not None
+                and (
+                    p.spec.affinity.pod_affinity is not None
+                    or p.spec.affinity.pod_anti_affinity is not None
+                )
+                or p.spec.topology_spread_constraints
+                for noms in nominated_by_node.values()
+                for p in noms
+            )
+        ):
+            # ADVICE r2 (medium): nominees are overlaid as RESOURCES
+            # only; the affinity/spread/score count tensors pack from
+            # the snapshot, which excludes them, so a constrained device
+            # batch could violate a nominee's symmetric constraints.
+            # The host path runs _add_nominated_pods exactly
+            # (generic_scheduler.go:535) -- take it for this rare
+            # combination (active nominations + constraints on either
+            # side).
+            self._drain_pending()
+            self.nominee_constrained_fallbacks += 1
+            for pi in solver_infos:
+                self.pods_fallback += 1
+                self.attempt_schedule(pi)
+            return None
         nt = self.tensor_cache.update(snapshot)
         batch = pack_pod_batch(
             pods, nt.dims, timestamps=[pi.timestamp for pi in solver_infos]

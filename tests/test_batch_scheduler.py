@@ -327,3 +327,49 @@ class TestSolverSupported:
 
     def test_node_selector_supported(self):
         assert solver_supported(make_pod("p").node_selector(pool="x").obj())
+
+
+class TestNomineeConstrainedFallback:
+    def test_constrained_batch_with_nominee_takes_host_path(self):
+        """ADVICE r2 (medium): nominee pods are overlaid as resources
+        only, so a constrained batch (affinity) with active nominations
+        must route to the host path where _add_nominated_pods runs the
+        full filter semantics."""
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.client.client import Client
+        from kubernetes_tpu.client.informer import InformerFactory
+
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(client, informers, batch=True, max_batch=16)
+        for i in range(3):
+            client.create_node(
+                make_node(f"n{i}").labels(zone=f"z{i}")
+                .capacity(cpu="8", memory="16Gi").obj()
+            )
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        # a standing nomination makes nominated_by_node non-empty
+        nominee = make_pod("nominee").container(cpu="1").priority(50).obj()
+        sched.queue.update_nominated_pod_for_node(nominee, "n0")
+        client.create_pod(
+            make_pod("anti").labels(app="a")
+            .container(cpu="100m", memory="128Mi")
+            .pod_affinity("zone", {"app": "a"}, anti=True)
+            .obj()
+        )
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            sched.schedule_batch(timeout=0.2)
+            pods, _ = client.list_pods()
+            if any(p.spec.node_name for p in pods):
+                break
+        sched.wait_for_inflight_binds()
+        sched.stop()
+        informers.stop()
+        pods, _ = client.list_pods()
+        assert any(p.spec.node_name for p in pods)
+        assert sched.nominee_constrained_fallbacks >= 1
+        assert sched.pods_fallback >= 1

@@ -99,3 +99,16 @@ def test_unknown_operator_raises():
 def test_compile_cached_on_selector():
     sel = LabelSelector(match_labels={"a": "b"})
     assert compile_selector(sel) is compile_selector(sel)
+
+
+def test_unknown_operator_not_reached_matches_python():
+    """Short-circuit parity: an unknown operator behind a failing
+    match_labels is never evaluated on either path."""
+    sel = LabelSelector(
+        match_labels={"a": "b"},
+        match_expressions=[
+            LabelSelectorRequirement(key="x", operator="Bogus", values=[])
+        ],
+    )
+    assert labels_match_selector({"a": "x"}, sel) is False
+    assert labels_match_selector_py({"a": "x"}, sel) is False
