@@ -42,12 +42,14 @@ from kubernetes_tpu.tensors.node_tensor import NUM_FIXED_DIMS, PODS
 
 NO_NODE = -1
 
-# lax.scan per-iteration dispatch overhead dominates the tiny per-pod
-# step at bench shapes (~50us/step for a [5000, 8] mask+score); XLA
-# unrolling amortizes it across UNROLL pods per loop trip
+# lax.scan unroll knob. Measured on the real chip: unroll=8 does NOT
+# change solve latency at bench shapes (~110ms either way for 2048x5120
+# -- the step cost is real vector work, not loop dispatch), while it
+# multiplies compiled-program size and GSPMD compile time (the 8-device
+# dryrun went 2.5min -> 5s at unroll=1). Default stays 1.
 import os as _os
 
-SCAN_UNROLL = int(_os.environ.get("KTPU_SCAN_UNROLL", "8"))
+SCAN_UNROLL = int(_os.environ.get("KTPU_SCAN_UNROLL", "1"))
 
 _PODS_COL = PODS  # the pod-count dimension of the node tensor
 

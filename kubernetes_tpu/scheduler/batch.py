@@ -1048,6 +1048,7 @@ class BatchScheduler(Scheduler):
                 slow.append((pi, choice, k))
 
         bulk: List[Tuple] = []
+        deferred: List[Tuple] = []  # sync-mode Permit waiters
         if plain:
             clones = []
             for pi, host in plain:
@@ -1157,6 +1158,15 @@ class BatchScheduler(Scheduler):
                         self._binding_cycle_safe, prof, state, pi, assumed,
                         host, pod_scheduling_cycle,
                     )
+                elif waiting:
+                    # synchronous binding + a Permit waiter: running the
+                    # cycle inline would block THIS loop on
+                    # wait_on_permit while the quorum it waits for is
+                    # later in the same batch (deadlock until the permit
+                    # timeout); defer until every pod is assumed
+                    deferred.append(
+                        (prof, state, pi, assumed, host)
+                    )
                 else:
                     self._binding_cycle(
                         prof, state, pi, assumed, host, pod_scheduling_cycle
@@ -1181,6 +1191,11 @@ class BatchScheduler(Scheduler):
                 self._inflight_binds += 1
             self._bind_pool.submit(
                 self._bulk_binding_cycle_safe, bulk, pod_scheduling_cycle
+            )
+        for prof_d, state_d, pi_d, assumed_d, host_d in deferred:
+            self._binding_cycle(
+                prof_d, state_d, pi_d, assumed_d, host_d,
+                pod_scheduling_cycle,
             )
 
     def _bulk_binding_cycle_safe(self, items, pod_scheduling_cycle) -> None:

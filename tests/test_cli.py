@@ -1,0 +1,80 @@
+"""The scheduler binary surface (cmd/kube-scheduler analogue): flag
+parsing, config/policy layering, feature gates."""
+
+import pytest
+
+from kubernetes_tpu.__main__ import build_parser, parse_feature_gates
+
+
+def test_flags_parse():
+    args = build_parser().parse_args(
+        [
+            "--config", "cfg.yaml",
+            "--healthz-bind-address", "127.0.0.1:10251",
+            "--leader-elect",
+            "--feature-gates", "TPUBatchSolver=true,EvenPodsSpread=false",
+            "--percentage-of-nodes-to-score", "50",
+            "-v",
+        ]
+    )
+    assert args.config == "cfg.yaml"
+    assert args.leader_elect is True
+    assert args.percentage_of_nodes_to_score == 50
+
+
+def test_feature_gates_parse():
+    assert parse_feature_gates("A=true, B=false") == {"A": True, "B": False}
+    assert parse_feature_gates("") == {}
+    with pytest.raises(SystemExit):
+        parse_feature_gates("A=maybe")
+
+
+def test_unknown_gate_rejected():
+    from kubernetes_tpu.config.loader import (
+        DEFAULT_FEATURE_GATES,
+        FeatureGate,
+    )
+
+    gates = FeatureGate(DEFAULT_FEATURE_GATES)
+    with pytest.raises(ValueError, match="unknown feature gate"):
+        gates.set_from_map({"NoSuchGate": True})
+
+
+def test_binary_boots_and_serves(tmp_path):
+    """python -m kubernetes_tpu boots, serves /healthz, schedules a pod
+    through the in-proc control plane, and shuts down."""
+    import time
+
+    from kubernetes_tpu.config.types import KubeSchedulerConfiguration
+    from kubernetes_tpu.scheduler.app import SchedulerApp
+    from kubernetes_tpu.testing import make_node, make_pod
+
+    app = SchedulerApp(config=KubeSchedulerConfiguration())
+    host, port = app.start_serving()
+    app.client.create_node(
+        make_node("n").capacity(cpu="4", memory="8Gi").obj()
+    )
+    app.start()
+    app.client.create_pod(make_pod("p").container(cpu="1").obj())
+
+    import urllib.request
+
+    body = urllib.request.urlopen(
+        f"http://{host}:{port}/healthz", timeout=5
+    ).read()
+    assert body == b"ok"
+
+    deadline = time.time() + 30
+    bound = False
+    while time.time() < deadline:
+        pod = app.client.get_pod("default", "p")
+        if pod.spec.node_name:
+            bound = True
+            break
+        time.sleep(0.05)
+    metrics_body = urllib.request.urlopen(
+        f"http://{host}:{port}/metrics", timeout=5
+    ).read().decode()
+    app.stop()
+    assert bound
+    assert "scheduler_schedule_attempts_total" in metrics_body
