@@ -53,6 +53,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument(
         "--percentage-of-nodes-to-score", type=int, default=None
     )
+    ap.add_argument(
+        "--manifest", action="append", default=[],
+        help="YAML manifest(s) of Pods/Nodes/PDBs/PodGroups/Services to "
+        "create at boot (the in-proc control plane's seed state)",
+    )
     ap.add_argument("-v", "--verbose", action="count", default=0)
     return ap
 
@@ -91,13 +96,23 @@ def main(argv=None) -> int:
         cfg.percentage_of_nodes_to_score = args.percentage_of_nodes_to_score
 
     gates = FeatureGate(DEFAULT_FEATURE_GATES)
-    overrides = parse_feature_gates(args.feature_gates)
-    overrides.update(cfg.feature_gates)
-    gates.set_from_map(overrides)
+    # precedence matches every other flag: YAML first, CLI overrides
+    overrides = dict(cfg.feature_gates)
+    overrides.update(parse_feature_gates(args.feature_gates))
+    try:
+        gates.set_from_map(overrides)
+    except ValueError as e:
+        raise SystemExit(f"--feature-gates: {e}") from None
 
     app = SchedulerApp(
         config=cfg, batch=gates.enabled("TPUBatchSolver")
     )
+    if args.manifest:
+        from kubernetes_tpu.api.serialization import load_manifest
+
+        for path in args.manifest:
+            for obj in load_manifest(path):
+                app.server.create(obj)
     host, port = app.start_serving()
     logging.getLogger("kubernetes_tpu").info(
         "serving healthz/metrics on %s:%s", host, port

@@ -10,11 +10,14 @@ mask, and selectHost becomes the argmax inside the assignment scan.
 
 The scheduling-framework contract stays intact: Reserve, Permit
 (gang-scheduling hook), PreBind, Bind and the failure/Unreserve paths run
-through the same Framework pipeline per pod (finish_schedule). Pods with
-constraints the solver doesn't model yet -- inter-pod (anti-)affinity,
-topology spread, host ports -- fall back to the sequential oracle path
-(attempt_schedule), exactly like the reference runs unsupported pods
-through extenders.
+through the same Framework pipeline per pod (finish_schedule). Required
+(anti-)affinity, topology spread, the full default score family
+(including preferred inter-pod affinity), gang quorum masks, and batched
+preemption all solve on device; the few remaining shapes the solver
+doesn't model (host ports, volume-bound pods, spread+nodeSelector
+eligibility coupling -- see solver_supported) fall back to the
+sequential oracle path (attempt_schedule), exactly like the reference
+runs unsupported pods through extenders.
 """
 
 from __future__ import annotations

@@ -50,31 +50,33 @@ def test_binary_boots_and_serves(tmp_path):
     from kubernetes_tpu.testing import make_node, make_pod
 
     app = SchedulerApp(config=KubeSchedulerConfiguration())
-    host, port = app.start_serving()
-    app.client.create_node(
-        make_node("n").capacity(cpu="4", memory="8Gi").obj()
-    )
-    app.start()
-    app.client.create_pod(make_pod("p").container(cpu="1").obj())
+    try:
+        host, port = app.start_serving()
+        app.client.create_node(
+            make_node("n").capacity(cpu="4", memory="8Gi").obj()
+        )
+        app.start()
+        app.client.create_pod(make_pod("p").container(cpu="1").obj())
 
-    import urllib.request
+        import urllib.request
 
-    body = urllib.request.urlopen(
-        f"http://{host}:{port}/healthz", timeout=5
-    ).read()
-    assert body == b"ok"
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/healthz", timeout=5
+        ).read()
+        assert body == b"ok"
 
-    deadline = time.time() + 30
-    bound = False
-    while time.time() < deadline:
-        pod = app.client.get_pod("default", "p")
-        if pod.spec.node_name:
-            bound = True
-            break
-        time.sleep(0.05)
-    metrics_body = urllib.request.urlopen(
-        f"http://{host}:{port}/metrics", timeout=5
-    ).read().decode()
-    app.stop()
+        deadline = time.time() + 30
+        bound = False
+        while time.time() < deadline:
+            pod = app.client.get_pod("default", "p")
+            if pod.spec.node_name:
+                bound = True
+                break
+            time.sleep(0.05)
+        metrics_body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=5
+        ).read().decode()
+    finally:
+        app.stop()
     assert bound
     assert "scheduler_schedule_attempts_total" in metrics_body
