@@ -111,8 +111,11 @@ def main(argv=None) -> int:
         from kubernetes_tpu.api.serialization import load_manifest
 
         for path in args.manifest:
-            for obj in load_manifest(path):
-                app.server.create(obj)
+            try:
+                for obj in load_manifest(path):
+                    app.server.create(obj)
+            except Exception as e:  # noqa: BLE001 - operator-facing
+                raise SystemExit(f"--manifest {path}: {e}") from None
     host, port = app.start_serving()
     logging.getLogger("kubernetes_tpu").info(
         "serving healthz/metrics on %s:%s", host, port

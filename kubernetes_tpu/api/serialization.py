@@ -602,3 +602,15 @@ def node_to_dict(node: Node) -> Dict[str, Any]:
         **({"spec": spec} if spec else {}),
         "status": status,
     }
+
+
+# ---------------------------------------------------------------------------
+# shared wire encoders (the extender payloads use the same camelCase
+# forms; scheduler/extender.py imports these instead of keeping a
+# parallel codec)
+# ---------------------------------------------------------------------------
+
+label_selector_to_wire = _label_selector_dict
+node_selector_term_to_wire = _node_selector_term_dict
+pod_affinity_term_to_wire = _pod_affinity_term_dict
+affinity_to_wire = _affinity_dict

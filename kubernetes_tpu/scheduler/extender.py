@@ -44,6 +44,11 @@ class ExtenderConfig:
     http_timeout_seconds: float = DEFAULT_EXTENDER_TIMEOUT_SECONDS
 
 
+from kubernetes_tpu.api.serialization import (
+    affinity_to_wire as _affinity_to_wire,
+)
+
+
 def _quantity_to_wire(name: str, qty: int) -> str:
     # internal base units: cpu milliCPU, memory/ephemeral bytes, extended
     # whole units (api/types.py ResourceList)
@@ -54,86 +59,6 @@ def _quantity_to_wire(name: str, qty: int) -> str:
 
 def _resource_list_to_wire(rl: dict) -> dict:
     return {name: _quantity_to_wire(name, q) for name, q in rl.items()}
-
-
-def _label_selector_to_wire(sel) -> dict:
-    out: dict = {}
-    if sel.match_labels:
-        out["matchLabels"] = dict(sel.match_labels)
-    if sel.match_expressions:
-        out["matchExpressions"] = [
-            {"key": r.key, "operator": r.operator, "values": list(r.values)}
-            for r in sel.match_expressions
-        ]
-    return out
-
-
-def _node_selector_term_to_wire(term) -> dict:
-    out: dict = {}
-    if term.match_expressions:
-        out["matchExpressions"] = [
-            {"key": r.key, "operator": r.operator, "values": list(r.values)}
-            for r in term.match_expressions
-        ]
-    if term.match_fields:
-        out["matchFields"] = [
-            {"key": r.key, "operator": r.operator, "values": list(r.values)}
-            for r in term.match_fields
-        ]
-    return out
-
-
-def _pod_affinity_term_to_wire(term) -> dict:
-    out: dict = {"topologyKey": term.topology_key}
-    if term.label_selector is not None:
-        out["labelSelector"] = _label_selector_to_wire(term.label_selector)
-    if term.namespaces:
-        out["namespaces"] = list(term.namespaces)
-    return out
-
-
-def _affinity_to_wire(a) -> dict:
-    out: dict = {}
-    if a.node_affinity is not None:
-        na: dict = {}
-        if a.node_affinity.required_during_scheduling is not None:
-            na["requiredDuringSchedulingIgnoredDuringExecution"] = {
-                "nodeSelectorTerms": [
-                    _node_selector_term_to_wire(t)
-                    for t in a.node_affinity.required_during_scheduling.node_selector_terms
-                ]
-            }
-        if a.node_affinity.preferred_during_scheduling:
-            na["preferredDuringSchedulingIgnoredDuringExecution"] = [
-                {
-                    "weight": p.weight,
-                    "preference": _node_selector_term_to_wire(p.preference),
-                }
-                for p in a.node_affinity.preferred_during_scheduling
-            ]
-        out["nodeAffinity"] = na
-    for attr, key in (
-        ("pod_affinity", "podAffinity"),
-        ("pod_anti_affinity", "podAntiAffinity"),
-    ):
-        pa = getattr(a, attr)
-        if pa is not None:
-            out[key] = {
-                "requiredDuringSchedulingIgnoredDuringExecution": [
-                    _pod_affinity_term_to_wire(t)
-                    for t in pa.required_during_scheduling
-                ],
-                "preferredDuringSchedulingIgnoredDuringExecution": [
-                    {
-                        "weight": w.weight,
-                        "podAffinityTerm": _pod_affinity_term_to_wire(
-                            w.pod_affinity_term
-                        ),
-                    }
-                    for w in pa.preferred_during_scheduling
-                ],
-            }
-    return out
 
 
 def _pod_to_wire(pod: Pod) -> dict:
