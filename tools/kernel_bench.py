@@ -82,6 +82,36 @@ def main() -> None:
     jax.block_until_ready(out)
     print(f"dispatch (async) returned in {t_dispatch*1000:.1f} ms")
 
+    # A/B vs the fused Pallas kernel via forced 10-solve chains (the
+    # serving link's ~100ms round trip masks single-solve timings)
+    from kubernetes_tpu.ops.pallas_solver import pallas_greedy_solve
+
+    def chain(fn, k):
+        a = out[0]
+        req_s, nzr_s = up[1], up[2]
+        for _ in range(k):
+            a, req_s, nzr_s = fn(
+                up[0], req_s, nzr_s, up[3], up[4], up[5], up[6], up[7],
+                up[8], config=cfg,
+            )
+        return np.asarray(a)
+
+    chain(pallas_greedy_solve, 1)  # compile
+    for name, fn in (
+        ("xla   ", greedy_assign_compact),
+        ("pallas", pallas_greedy_solve),
+    ):
+        t1 = time.perf_counter()
+        chain(fn, 1)
+        one = time.perf_counter() - t1
+        t1 = time.perf_counter()
+        chain(fn, 10)
+        ten = time.perf_counter() - t1
+        print(
+            f"{name}: marginal solve ~{(ten - one) / 9 * 1000:.1f} ms "
+            f"(chain1 {one*1000:.0f} ms, chain10 {ten*1000:.0f} ms)"
+        )
+
 
 if __name__ == "__main__":
     main()
