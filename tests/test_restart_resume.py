@@ -1,0 +1,81 @@
+"""Checkpoint/resume philosophy (SURVEY section 5): all scheduler state
+is SOFT -- a replacement instance rebuilds cache/queue/device tensors
+from the API via list+watch and carries on, mid-workload.
+
+Reference: scheduler HA semantics (server.go:241: a new leader re-lists
+and resumes; nothing is persisted by the scheduler itself).
+"""
+
+import time
+
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _wait_bound(client, count, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        pods, _ = client.list_pods()
+        bound = sum(1 for p in pods if p.spec.node_name)
+        if bound >= count:
+            return bound
+        time.sleep(0.05)
+    return sum(1 for p in client.list_pods()[0] if p.spec.node_name)
+
+
+def test_replacement_scheduler_resumes_mid_burst():
+    server = APIServer()
+    client = Client(server)
+    for i in range(6):
+        client.create_node(
+            make_node(f"n{i}").capacity(cpu="8", memory="16Gi", pods=30).obj()
+        )
+
+    # first instance schedules half the burst, then dies
+    informers1 = InformerFactory(server)
+    sched1 = new_scheduler(client, informers1, batch=True, max_batch=16)
+    informers1.start()
+    informers1.wait_for_cache_sync()
+    sched1.queue.run()
+    for i in range(24):
+        client.create_pod(
+            make_pod(f"p{i}").container(cpu="250m", memory="256Mi").obj()
+        )
+    sched1.start()
+    assert _wait_bound(client, 8) >= 8
+    sched1.stop()
+    informers1.stop()
+
+    # more pods land while nobody is scheduling
+    for i in range(24, 36):
+        client.create_pod(
+            make_pod(f"p{i}").container(cpu="250m", memory="256Mi").obj()
+        )
+
+    # a FRESH instance (new informers, cache, queue, tensor cache)
+    # rebuilds everything from the API and finishes the workload
+    informers2 = InformerFactory(server)
+    sched2 = new_scheduler(client, informers2, batch=True, max_batch=16)
+    informers2.start()
+    informers2.wait_for_cache_sync()
+    sched2.queue.run()
+    sched2.start()
+    bound = _wait_bound(client, 36, timeout=60.0)
+    sched2.wait_for_inflight_binds()
+    sched2.stop()
+    informers2.stop()
+    assert bound == 36, f"only {bound}/36 bound after restart"
+
+    # no double-booking across the handover: every pod exactly one node,
+    # per-node capacity respected
+    pods, _ = client.list_pods()
+    per_node = {}
+    for p in pods:
+        assert p.spec.node_name, f"{p.metadata.name} unbound"
+        per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+    assert all(v <= 30 for v in per_node.values())
+    # the replacement's cache agrees with the API view
+    assert sched2.cache.pod_count() == 36
