@@ -287,6 +287,24 @@ class APIServer:
             self._broadcast(kind, WatchEvent(DELETED, obj, rv))
             return obj
 
+    def delete_bulk(
+        self, kind: str, keys: List[Tuple[str, str]]
+    ) -> int:
+        """Delete many objects of one kind in a single transaction with
+        one bulk watch fan-out (the eviction analogue of bind_bulk);
+        missing keys are skipped. Returns the number deleted."""
+        events: List[WatchEvent] = []
+        with self._lock:
+            self._ensure_kind(kind)
+            store = self._stores[kind]
+            for namespace, name in keys:
+                obj = store.pop((namespace, name), None)
+                if obj is None:
+                    continue
+                events.append(WatchEvent(DELETED, obj, self._next_rv()))
+            self._broadcast_many(kind, events)
+        return len(events)
+
     # -- watch --------------------------------------------------------------
 
     def watch(self, kind: str, since_rv: int = 0) -> Watch:

@@ -171,15 +171,24 @@ class SchedulerCache:
             self._add_pod_to_node(new)
             self._pod_states[new.metadata.uid] = _PodState(pod=new, assumed=False)
 
-    def remove_pod(self, pod: Pod) -> None:
+    def _remove_pod_locked(self, pod: Pod) -> None:
         key = pod.metadata.uid
+        state = self._pod_states.get(key)
+        if state is None:
+            return
+        self._remove_pod_from_node(state.pod)
+        del self._pod_states[key]
+        self._assumed_pods.pop(key, None)
+
+    def remove_pod(self, pod: Pod) -> None:
         with self._lock:
-            state = self._pod_states.get(key)
-            if state is None:
-                return
-            self._remove_pod_from_node(state.pod)
-            del self._pod_states[key]
-            self._assumed_pods.pop(key, None)
+            self._remove_pod_locked(pod)
+
+    def remove_pods(self, pods: List[Pod]) -> None:
+        """Bulk remove under one lock hold (eviction/delete frames)."""
+        with self._lock:
+            for pod in pods:
+                self._remove_pod_locked(pod)
 
     def get_pod(self, pod: Pod) -> Optional[Pod]:
         with self._lock:

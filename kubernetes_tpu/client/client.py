@@ -38,6 +38,11 @@ class Client:
     def delete_pod(self, namespace: str, name: str) -> Pod:
         return self._server.delete("Pod", namespace, name)
 
+    def delete_pods_bulk(self, keys: List[Tuple[str, str]]) -> int:
+        """One transaction deleting many pods (preemption evicts whole
+        victim sets); missing pods are skipped."""
+        return self._server.delete_bulk("Pod", keys)
+
     def bind(self, binding: Binding) -> Pod:
         """POST pods/<name>/binding (reference default_binder.go:50)."""
         return self._server.bind(binding)

@@ -67,42 +67,63 @@ def add_all_event_handlers(
         """Whole-frame bridge for assigned pods: the bind-echo burst
         (thousands of MODIFIED events per frame during a 10k burst) is
         confirmed into the cache under one lock and wakes affinity
-        matches with one move request. Only CONSECUTIVE adds coalesce --
-        any other transition flushes first, so per-pod event order within
-        the frame is preserved (an add+delete pair must not resurrect
-        the pod by deferring its add past its delete)."""
+        matches with one move request; delete runs (preemption waves)
+        coalesce into one bulk cache remove + ONE queue move. Adds and
+        deletes never buffer simultaneously -- appending to either run
+        flushes the other first, and updates flush both -- so per-pod
+        event order within the frame is preserved (an add+delete pair
+        must not resurrect the pod by deferring its add past its
+        delete)."""
         adds = []
+        deletes = []
 
-        def flush_adds() -> None:
-            if not adds:
-                return
-            try:
-                sched.cache.add_pods(adds)
-            except Exception:
-                logger.exception("bulk add pods to cache")
-            sched.queue.assigned_pods_added_many(adds)
-            adds.clear()
+        def flush() -> None:
+            if adds:
+                try:
+                    sched.cache.add_pods(adds)
+                except Exception:
+                    logger.exception("bulk add pods to cache")
+                sched.queue.assigned_pods_added_many(adds)
+                adds.clear()
+            if deletes:
+                # one bulk cache remove + ONE queue move for the run (a
+                # preemption wave deletes hundreds of victims per frame;
+                # per-event this was a move_all PER victim)
+                try:
+                    sched.cache.remove_pods(deletes)
+                except Exception:
+                    logger.exception("bulk remove pods from cache")
+                sched.queue.move_all_to_active_or_backoff_queue(
+                    events.AssignedPodDelete
+                )
+                deletes.clear()
 
         for etype, old, new in frame:
             new_ok = _assigned(new)
             old_ok = old is not None and _assigned(old)
             if etype == "ADDED":
                 if new_ok:
+                    if deletes:
+                        flush()
                     adds.append(new)
             elif etype == "MODIFIED":
                 if old_ok and new_ok:
-                    flush_adds()
+                    flush()
                     update_pod_in_cache(old, new)
                 elif not old_ok and new_ok:
+                    if deletes:
+                        flush()
                     adds.append(new)
                 elif old_ok and not new_ok:
-                    flush_adds()
-                    delete_pod_from_cache(old)
+                    if adds:
+                        flush()
+                    deletes.append(old)
             elif etype == "DELETED":
                 if new_ok:
-                    flush_adds()
-                    delete_pod_from_cache(new)
-        flush_adds()
+                    if adds:
+                        flush()
+                    deletes.append(new)
+        flush()
 
     pods.add_event_handler(
         ResourceEventHandler(

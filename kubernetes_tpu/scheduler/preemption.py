@@ -517,14 +517,36 @@ class Preemptor:
             return results
         answers = self._device_answers(live_pods, potentials, pdbs)
         self.device_preemptions += len(live_pods)
+        all_victims = {}
         for k, pod, (node_name, victims, _) in zip(
             live, live_pods, answers
         ):
             metrics.preemption_attempts.inc()
             if node_name:
                 metrics.preemption_victims.observe(len(victims))
-                if self._apply_preemption(prof, pod, node_name, victims):
+                if self._apply_preemption(
+                    prof, pod, node_name, victims, delete_victims=False
+                ):
                     results[k] = node_name
+                    for v in victims:
+                        all_victims[v.metadata.uid] = v
+        # one eviction transaction for the whole group (victims chosen
+        # by several pods dedup by uid; deletion is idempotent)
+        if all_victims:
+            if self.client is not None:
+                try:
+                    self.client.delete_pods_bulk(
+                        [
+                            (v.metadata.namespace, v.metadata.name)
+                            for v in all_victims.values()
+                        ]
+                    )
+                except Exception:
+                    logger.exception("bulk victim eviction")
+            for v in all_victims.values():
+                waiting = prof.get_waiting_pod(v.metadata.uid)
+                if waiting is not None:
+                    waiting.reject("preemption", "preempted")
         return results
 
     def _clear_nomination(self, pod: Pod) -> None:
@@ -541,13 +563,20 @@ class Preemptor:
                 logger.exception("clearing nominatedNodeName")
 
     def _apply_preemption(
-        self, prof, pod: Pod, node_name: str, victims: List[Pod]
+        self,
+        prof,
+        pod: Pod,
+        node_name: str,
+        victims: List[Pod],
+        delete_victims: bool = True,
     ) -> bool:
         """The API side effects of one successful preemption
         (scheduler.go:392): nominate, delete victims, clear superseded
         lower-priority nominations. Returns False when the nomination
         write failed and was rolled back (no victims were evicted) --
-        callers must then report no nomination."""
+        callers must then report no nomination. ``delete_victims=False``
+        lets preempt_batch evict the whole group's victims in one
+        transaction afterwards."""
         self.queue.update_nominated_pod_for_node(pod, node_name)
         if self.client is not None:
             try:
@@ -569,6 +598,8 @@ class Preemptor:
                     f"Preempted by {pod.metadata.namespace}/"
                     f"{pod.metadata.name} on node {node_name}",
                 )
+            if not delete_victims:
+                continue
             if self.client is not None:
                 try:
                     self.client.delete_pod(
