@@ -326,6 +326,35 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
                 "Perc90": _percentile(samples, 90),
                 "Perc99": _percentile(samples, 99),
             }
+        # placement-quality: per-node cpu utilization spread (the churn
+        # workloads exist to compare greedy vs the sinkhorn global
+        # prior; throughput alone can't show placement quality)
+        from kubernetes_tpu.api.types import (
+            RESOURCE_CPU,
+            pod_resource_requests,
+        )
+
+        node_cpu: Dict[str, int] = {}
+        for p in client.list_pods()[0]:
+            if p.spec.node_name:
+                node_cpu[p.spec.node_name] = node_cpu.get(
+                    p.spec.node_name, 0
+                ) + pod_resource_requests(p).get(RESOURCE_CPU, 0)
+        utils = []
+        for node_obj in client.list_nodes()[0]:
+            cap = node_obj.status.allocatable.get(RESOURCE_CPU, 0)
+            if cap:
+                utils.append(
+                    node_cpu.get(node_obj.metadata.name, 0) / cap
+                )
+        if utils:
+            mean = sum(utils) / len(utils)
+            var = sum((u - mean) ** 2 for u in utils) / len(utils)
+            result["utilization_cpu"] = {
+                "mean": round(mean, 4),
+                "std": round(var ** 0.5, 4),
+                "max": round(max(utils), 4),
+            }
         result["solver"] = {
             "batches": sched.batches_solved,
             "pods_on_device": sched.pods_solved_on_device,

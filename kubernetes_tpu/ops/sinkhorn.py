@@ -70,7 +70,9 @@ def refine_scores(
     iters: int = 50,
     tau: float = 20.0,
 ) -> jnp.ndarray:
-    """Blend the transport plan into a score matrix for the commit scan:
-    plan mass dominates, raw score breaks ties among equal-mass nodes."""
+    """Scale the transport plan into a score matrix for the commit scan.
+    The commit scan adds its own DYNAMIC resource score as the
+    tie-breaker (with within-batch load feedback); appending the static
+    score here would double-count it."""
     plan = sinkhorn_plan(score, feasible, node_slots, active, iters, tau)
-    return plan * 1e4 + jnp.where(feasible, score, 0.0)
+    return plan * 1e4

@@ -533,6 +533,7 @@ class Preemptor:
         # one eviction transaction for the whole group (victims chosen
         # by several pods dedup by uid; deletion is idempotent)
         if all_victims:
+            evicted = True
             if self.client is not None:
                 try:
                     self.client.delete_pods_bulk(
@@ -542,11 +543,16 @@ class Preemptor:
                         ]
                     )
                 except Exception:
+                    # nominations stand (they self-heal on the pods'
+                    # retries), but waiting victims must NOT be rejected
+                    # for an eviction that never happened
                     logger.exception("bulk victim eviction")
-            for v in all_victims.values():
-                waiting = prof.get_waiting_pod(v.metadata.uid)
-                if waiting is not None:
-                    waiting.reject("preemption", "preempted")
+                    evicted = False
+            if evicted:
+                for v in all_victims.values():
+                    waiting = prof.get_waiting_pod(v.metadata.uid)
+                    if waiting is not None:
+                        waiting.reject("preemption", "preempted")
         return results
 
     def _clear_nomination(self, pod: Pod) -> None:
