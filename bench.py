@@ -168,7 +168,11 @@ def main() -> None:
                 create_times[p.metadata.name] = now
             client.create_pods_bulk(chunk)
 
+    from kubernetes_tpu.utils import timeline
+
+    timeline.reset()
     start = time.perf_counter()
+    timeline.mark("burst_start")
     creators = [
         threading.Thread(target=create_shard, args=(s,)) for s in shards
     ]
@@ -176,7 +180,9 @@ def main() -> None:
         c.start()
     for c in creators:
         c.join()
+    timeline.mark("creates_done")
     completed = watcher.wait_for_targets(time.time() + 600)
+    timeline.mark("all_bound")
     elapsed = time.perf_counter() - start
     sched.wait_for_inflight_binds(timeout=60)
     watcher.stop()
@@ -206,6 +212,9 @@ def main() -> None:
     )
     p50 = latencies[len(latencies) // 2]
     p99 = latencies[min(len(latencies) - 1, (len(latencies) * 99) // 100)]
+
+    if timeline.ENABLED:
+        print(timeline.dump(start), file=sys.stderr)
 
     pods_per_sec = num_pods / elapsed
     print(

@@ -26,6 +26,26 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api.types import Binding, Node, Pod
 
+try:
+    from kubernetes_tpu.native import cow_clone as _cow_clone
+except Exception:  # noqa: BLE001 - pure-Python fallback
+    _cow_clone = None
+
+_POD_COW_ATTRS = ("metadata", "spec", "status")
+
+#: scheduler-side memo keys that ride object __dict__ copies. The bind
+#: path only writes spec.node_name, which invalidates just the static-
+#: mask signature; arbitrary updates (guaranteed_update's mutate, a
+#: client update) may change anything, so every memo must go.
+_SIG_MEMO = "_sig_memo"
+_ALL_MEMOS = ("_sig_memo", "_hot_memo", "_req_memo", "_nzr_memo")
+
+
+def _strip_memos(obj: Any) -> None:
+    d = obj.__dict__
+    for k in _ALL_MEMOS:
+        d.pop(k, None)
+
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
@@ -243,6 +263,9 @@ class APIServer:
                     f"{kind} {key}: resourceVersion {expect_rv} is stale "
                     f"(current {current.metadata.resource_version})"
                 )
+            # the replacement may be a clone carrying scheduler memos
+            # computed against the OLD spec
+            _strip_memos(obj)
             obj.metadata.resource_version = self._next_rv()
             store[key] = obj
             self._broadcast(
@@ -264,11 +287,16 @@ class APIServer:
 
         with self._lock:
             old = self.get(kind, namespace, name)
-            obj = _copy.copy(old)
-            obj.metadata = _copy.copy(old.metadata)
-            for attr in ("spec", "status"):
-                if hasattr(old, attr):
+            cow_attrs = tuple(
+                a for a in _POD_COW_ATTRS if hasattr(old, a)
+            )
+            if _cow_clone is not None:
+                obj = _cow_clone(old, cow_attrs)
+            else:
+                obj = _copy.copy(old)
+                for attr in cow_attrs:
                     setattr(obj, attr, _copy.copy(getattr(old, attr)))
+            _strip_memos(obj)
             mutate(obj)
             obj.metadata.resource_version = self._next_rv()
             self._stores[kind][(namespace, name)] = obj
@@ -330,9 +358,14 @@ class APIServer:
         """Validate + apply one binding; caller holds the store lock.
         Returns the updated pod and appends nothing -- the caller decides
         how to fan out the watch event (single vs bulk delivery)."""
-        import copy as _copy
-
-        old: Pod = self.get("Pod", binding.pod_namespace, binding.pod_name)
+        store = self._stores["Pod"]
+        old: Optional[Pod] = store.get(
+            (binding.pod_namespace, binding.pod_name)
+        )
+        if old is None:
+            raise NotFound(
+                f"Pod {binding.pod_namespace}/{binding.pod_name} not found"
+            )
         if binding.pod_uid and old.metadata.uid != binding.pod_uid:
             raise Conflict(
                 f"pod {old.key()} uid mismatch: binding has "
@@ -344,14 +377,22 @@ class APIServer:
             )
         if not binding.target_node:
             raise ValueError("binding.target_node is required")
-        # copy-on-write update (guaranteed_update semantics)
-        pod = _copy.copy(old)
-        pod.metadata = _copy.copy(old.metadata)
-        pod.spec = _copy.copy(old.spec)
-        pod.status = _copy.copy(old.status)
+        # copy-on-write update (guaranteed_update semantics); the native
+        # clone replaces a 4-deep copy.copy chain on the burst's hottest
+        # store transaction (10k binds per measured window)
+        if _cow_clone is not None:
+            pod = _cow_clone(old, _POD_COW_ATTRS)
+        else:
+            import copy as _copy
+
+            pod = _copy.copy(old)
+            pod.metadata = _copy.copy(old.metadata)
+            pod.spec = _copy.copy(old.spec)
+            pod.status = _copy.copy(old.status)
         pod.spec.node_name = binding.target_node
+        pod.__dict__.pop(_SIG_MEMO, None)
         pod.metadata.resource_version = self._next_rv()
-        self._stores["Pod"][(binding.pod_namespace, binding.pod_name)] = pod
+        store[(binding.pod_namespace, binding.pod_name)] = pod
         return pod
 
     def bind(self, binding: Binding) -> Pod:

@@ -105,6 +105,27 @@ def non_zero_requests(pod: Pod) -> Tuple[int, int]:
     return cpu, mem
 
 
+def pod_hot_info(pod: Pod) -> Tuple:
+    """Per-pod accounting deltas, memoized once (same immutability
+    contract as ``pod_resource_requests``): (milli_cpu, memory,
+    ephemeral, scalar_items, nzr_cpu, nzr_mem, has_affinity,
+    host_ports). NodeInfo.add_pod/remove_pod run once per pod per
+    assume/evict, and re-deriving these from the spec dicts was the
+    single largest slice of the burst's bulk-assume wall time."""
+    memo = pod.__dict__.get("_hot_memo")
+    if memo is not None:
+        return memo
+    r = new_resource(pod_resource_requests(pod))
+    cpu, mem = non_zero_requests(pod)
+    memo = (
+        r.milli_cpu, r.memory, r.ephemeral_storage,
+        tuple(r.scalar.items()), cpu, mem,
+        pod_has_affinity_constraints(pod), tuple(pod_host_ports(pod)),
+    )
+    pod.__dict__["_hot_memo"] = memo
+    return memo
+
+
 def pod_has_affinity_constraints(pod: Pod) -> bool:
     a = pod.spec.affinity
     return a is not None and (
@@ -186,15 +207,23 @@ class NodeInfo:
     # -- pods ---------------------------------------------------------------
 
     def add_pod(self, pod: Pod) -> None:
-        req = pod_resource_requests(pod)
-        self.requested.add(req)
-        cpu, mem = non_zero_requests(pod)
+        (
+            milli, mem_b, eph, scalars, cpu, mem, has_aff, ports,
+        ) = pod_hot_info(pod)
+        req = self.requested
+        req.milli_cpu += milli
+        req.memory += mem_b
+        req.ephemeral_storage += eph
+        if scalars:
+            sc = req.scalar
+            for name, qty in scalars:
+                sc[name] = sc.get(name, 0) + qty
         self.non_zero_requested.milli_cpu += cpu
         self.non_zero_requested.memory += mem
         self.pods.append(pod)
-        if pod_has_affinity_constraints(pod):
+        if has_aff:
             self.pods_with_affinity.append(pod)
-        for ip, proto, port in pod_host_ports(pod):
+        for ip, proto, port in ports:
             self.used_ports.add(ip, proto, port)
         self.generation = next_generation()
 
@@ -208,12 +237,20 @@ class NodeInfo:
         self.pods_with_affinity = [
             p for p in self.pods_with_affinity if p.metadata.uid != pod.metadata.uid
         ]
-        req = pod_resource_requests(pod)
-        self.requested.sub(req)
-        cpu, mem = non_zero_requests(pod)
+        (
+            milli, mem_b, eph, scalars, cpu, mem, _has_aff, ports,
+        ) = pod_hot_info(pod)
+        req = self.requested
+        req.milli_cpu -= milli
+        req.memory -= mem_b
+        req.ephemeral_storage -= eph
+        if scalars:
+            sc = req.scalar
+            for name, qty in scalars:
+                sc[name] = sc.get(name, 0) - qty
         self.non_zero_requested.milli_cpu -= cpu
         self.non_zero_requested.memory -= mem
-        for ip, proto, port in pod_host_ports(pod):
+        for ip, proto, port in ports:
             self.used_ports.remove(ip, proto, port)
         self.generation = next_generation()
         return True

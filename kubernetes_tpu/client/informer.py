@@ -16,6 +16,8 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from kubernetes_tpu.utils import timeline as _timeline
+
 from kubernetes_tpu.apiserver.server import (
     ADDED,
     APIServer,
@@ -121,6 +123,10 @@ class Informer:
         cache, queue -- and must not nest inside the store lock)."""
         if not evs:
             return
+        with _timeline.span(f"informer.apply[{self.kind}]"):
+            self._apply_batch_inner(evs)
+
+    def _apply_batch_inner(self, evs: List[WatchEvent]) -> None:
         dispatch = []
         with self._lock:
             store = self._store

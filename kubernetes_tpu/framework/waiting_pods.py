@@ -79,6 +79,11 @@ class WaitingPodsMap:
         self._lock = threading.RLock()
         self._pods: Dict[str, WaitingPod] = {}  # uid -> WaitingPod
 
+    def __len__(self) -> int:
+        # len()/truthiness mirror the underlying map so hot paths can ask
+        # "any Permit waiters at all?" without taking the lock per pod
+        return len(self._pods)
+
     def add(self, wp: WaitingPod) -> None:
         with self._lock:
             self._pods[wp.pod.metadata.uid] = wp

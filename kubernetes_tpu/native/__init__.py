@@ -70,3 +70,8 @@ try:
         from kubernetes_tpu.native import _hotpath as hotpath  # type: ignore
 except Exception:  # noqa: BLE001 - pure-Python fallback
     hotpath = None
+
+#: single source of truth for the native clone fast path: callers do
+#: ``from kubernetes_tpu.native import cow_clone`` and fall back to
+#: copy.copy chains when it is None (build/import failure, stale .so)
+cow_clone = getattr(hotpath, "cow_clone", None)

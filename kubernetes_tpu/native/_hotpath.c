@@ -174,6 +174,82 @@ dict_covers(PyObject *self, PyObject *args)
     Py_RETURN_TRUE;
 }
 
+/* -- copy-on-write object clones (the commit-path hot loop) -------------
+ *
+ * The bulk bind/assume pipeline clones every pod 2-4 times per commit
+ * (assumed_clone: pod+spec; _bind_locked: pod+metadata+spec+status).
+ * copy.copy() routes each clone through __reduce_ex__/_reconstruct at
+ * ~5-7us a call; at 10k pods x 6 clones that is ~0.4s of the measured
+ * burst window. cow_clone() does the same thing the direct way: allocate
+ * via the type (no __init__), dict-copy __dict__, and shallow-clone the
+ * named nested attributes in the same call. Reference analogue: the Go
+ * scheduler's pod.DeepCopy() before assume (scheduler.go:474) -- ours is
+ * shallow because downstream only writes spec.node_name /
+ * metadata.resource_version (the informer-cache read-only contract).
+ */
+
+static PyObject *str_dict = NULL; /* interned "__dict__" */
+
+static PyObject *
+shallow_clone_one(PyObject *obj)
+{
+    PyTypeObject *tp = Py_TYPE(obj);
+    PyObject *new = tp->tp_alloc(tp, 0);
+    if (new == NULL)
+        return NULL;
+    PyObject *d = PyObject_GetAttr(obj, str_dict);
+    if (d == NULL) {
+        Py_DECREF(new);
+        return NULL;
+    }
+    PyObject *dc = PyDict_Copy(d);
+    Py_DECREF(d);
+    if (dc == NULL) {
+        Py_DECREF(new);
+        return NULL;
+    }
+    if (PyObject_SetAttr(new, str_dict, dc) < 0) {
+        Py_DECREF(dc);
+        Py_DECREF(new);
+        return NULL;
+    }
+    Py_DECREF(dc);
+    return new;
+}
+
+static PyObject *
+cow_clone(PyObject *self, PyObject *args)
+{
+    /* cow_clone(obj, ("spec", "status", ...)) -> clone
+     * Shallow-clones obj, then shallow-clones each named attribute on
+     * the clone so the caller may mutate those sub-objects freely. */
+    PyObject *obj, *attrs;
+    if (!PyArg_ParseTuple(args, "OO!", &obj, &PyTuple_Type, &attrs))
+        return NULL;
+    PyObject *new = shallow_clone_one(obj);
+    if (new == NULL)
+        return NULL;
+    Py_ssize_t n = PyTuple_GET_SIZE(attrs);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *name = PyTuple_GET_ITEM(attrs, i);
+        PyObject *sub = PyObject_GetAttr(obj, name);
+        if (sub == NULL)
+            goto fail;
+        PyObject *subc = shallow_clone_one(sub);
+        Py_DECREF(sub);
+        if (subc == NULL)
+            goto fail;
+        int r = PyObject_SetAttr(new, name, subc);
+        Py_DECREF(subc);
+        if (r < 0)
+            goto fail;
+    }
+    return new;
+fail:
+    Py_DECREF(new);
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
     {"match_compiled", match_compiled, METH_VARARGS,
      "match_compiled(labels, compiled) -> bool"},
@@ -181,6 +257,9 @@ static PyMethodDef methods[] = {
      "match_mask(labels_list, compiled) -> bytes"},
     {"dict_covers", dict_covers, METH_VARARGS,
      "dict_covers(labels, selector_dict) -> bool"},
+    {"cow_clone", cow_clone, METH_VARARGS,
+     "cow_clone(obj, attr_names) -> shallow clone with named attrs "
+     "also shallow-cloned"},
     {NULL, NULL, 0, NULL},
 };
 
@@ -193,5 +272,8 @@ static struct PyModuleDef moduledef = {
 PyMODINIT_FUNC
 PyInit__hotpath(void)
 {
+    str_dict = PyUnicode_InternFromString("__dict__");
+    if (str_dict == NULL)
+        return NULL;
     return PyModule_Create(&moduledef);
 }

@@ -37,10 +37,28 @@ _UNSCHEDULABLE_TAINT = Taint(
     key=TAINT_NODE_UNSCHEDULABLE, effect=TAINT_EFFECT_NO_SCHEDULE
 )
 
+_EMPTY_SIG: Tuple = ("", (), (), ())
+
 
 def _constraint_signature(pod: Pod) -> Tuple:
-    """Pods with equal signatures produce identical static mask rows."""
+    """Pods with equal signatures produce identical static mask rows.
+    Memoized per pod object (the pod-spec immutability contract of
+    ``pod_resource_requests``): retries re-pack the same pod every
+    batch."""
+    memo = pod.__dict__.get("_sig_memo")
+    if memo is not None:
+        return memo
     spec = pod.spec
+    if (
+        not spec.node_name
+        and not spec.node_selector
+        and not spec.tolerations
+        and (spec.affinity is None or spec.affinity.node_affinity is None)
+    ):
+        # the burst common case: no placement constraints at all -- skip
+        # the per-pod tuple assembly entirely
+        pod.__dict__["_sig_memo"] = _EMPTY_SIG
+        return _EMPTY_SIG
     sel = tuple(sorted(spec.node_selector.items()))
     aff = ()
     if spec.affinity is not None and spec.affinity.node_affinity is not None:
@@ -62,7 +80,9 @@ def _constraint_signature(pod: Pod) -> Tuple:
     tols = tuple(
         (t.key, t.operator, t.value, t.effect) for t in spec.tolerations
     )
-    return (spec.node_name, sel, aff, tols)
+    memo = (spec.node_name, sel, aff, tols)
+    pod.__dict__["_sig_memo"] = memo
+    return memo
 
 
 def _tolerates_node_taints(pod: Pod, node) -> bool:

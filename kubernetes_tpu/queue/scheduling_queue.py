@@ -359,15 +359,27 @@ class PriorityQueue:
     def assigned_pods_added_many(self, pods: List[Pod]) -> None:
         """Frame variant of assigned_pod_added: one move request (one
         lock hold, one move_request_cycle bump, one wakeup) covering the
-        union of affinity-matched parked pods."""
+        union of affinity-matched parked pods.
+
+        Fast path: when no parked pod carries a pod-affinity term (the
+        10k-burst steady state), the per-assigned-pod match scan is pure
+        overhead -- skip straight to the empty move, which still bumps
+        move_request_cycle (the lost-wakeup guard for pods mid-attempt)."""
+        with self._lock:
+            any_affinity_parked = any(
+                pi.pod.spec.affinity is not None
+                and pi.pod.spec.affinity.pod_affinity is not None
+                for pi in self.unschedulable_q.values()
+            )
         matched: List[PodInfo] = []
-        seen = set()
-        for pod in pods:
-            for pi in self._pods_with_matching_affinity_term(pod):
-                key = _info_key(pi)
-                if key not in seen:
-                    seen.add(key)
-                    matched.append(pi)
+        if any_affinity_parked:
+            seen = set()
+            for pod in pods:
+                for pi in self._pods_with_matching_affinity_term(pod):
+                    key = _info_key(pi)
+                    if key not in seen:
+                        seen.add(key)
+                        matched.append(pi)
         self.move_pods_to_active_or_backoff_queue(
             matched, events.AssignedPodAdd
         )

@@ -76,6 +76,7 @@ from kubernetes_tpu.scheduler.generic import SNAPSHOT_STATE_KEY
 from kubernetes_tpu.scheduler.scheduler import Scheduler
 from kubernetes_tpu.tensors import NodeTensorCache, pack_pod_batch
 from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import timeline
 
 logger = logging.getLogger(__name__)
 
@@ -504,6 +505,7 @@ class BatchScheduler(Scheduler):
         the sequential path. Paths that read host-side cluster state the
         in-flight batch would change (spread counts, nominee overlays,
         incompatible clusters) drain the pipeline first."""
+        timeline.mark(f"dispatch_start b={len(solver_infos)}")
         pods = [pi.pod for pi in solver_infos]
         has_hard_spread = any(
             c.when_unsatisfiable == "DoNotSchedule"
@@ -611,11 +613,15 @@ class BatchScheduler(Scheduler):
                 self.pods_fallback += 1
                 self.attempt_schedule(pi)
             return None
-        nt = self.tensor_cache.update(snapshot)
-        batch = pack_pod_batch(
-            pods, nt.dims, timestamps=[pi.timestamp for pi in solver_infos]
-        )
-        mask_rows, mask_index = static_mask_compact(pods, snapshot, nt)
+        with timeline.span("nt.update"):
+            nt = self.tensor_cache.update(snapshot)
+        with timeline.span("pack_pod_batch"):
+            batch = pack_pod_batch(
+                pods, nt.dims,
+                timestamps=[pi.timestamp for pi in solver_infos],
+            )
+        with timeline.span("static_mask"):
+            mask_rows, mask_index = static_mask_compact(pods, snapshot, nt)
         # pods requesting resources no node advertises are unsatisfiable:
         # point them at a dedicated all-False row
         if batch.unsatisfiable.any():
@@ -795,16 +801,17 @@ class BatchScheduler(Scheduler):
             # pass None for pieces riding the buffer so the jit sees one
             # stable signature per layout (a stale device ref would fork
             # a needless compile variant)
-            (
-                assignments_dev, req_out, nzr_out, alloc_out, valid_out,
-            ) = solve_packed(
-                pieces,
-                ds.alloc_dev if static_ok else None,
-                ds.valid_dev if static_ok else None,
-                ds.req_dev if carry_ok else None,
-                ds.nzr_dev if carry_ok else None,
-                config=self.solver_config, mode=self.solver_mode,
-            )
+            with timeline.span("solve_dispatch"):
+                (
+                    assignments_dev, req_out, nzr_out, alloc_out, valid_out,
+                ) = solve_packed(
+                    pieces,
+                    ds.alloc_dev if static_ok else None,
+                    ds.valid_dev if static_ok else None,
+                    ds.req_dev if carry_ok else None,
+                    ds.nzr_dev if carry_ok else None,
+                    config=self.solver_config, mode=self.solver_mode,
+                )
             if not static_ok:
                 ds.alloc_dev, ds.valid_dev = alloc_out, valid_out
                 ds.alloc_shadow = nt.allocatable.copy()
@@ -954,7 +961,8 @@ class BatchScheduler(Scheduler):
         """Download the assignments, mirror the scan's node-state deltas
         into the host shadow (same int32 arithmetic), then run the batched
         commit pipeline."""
-        assignments = np.asarray(p["assignments_dev"])
+        with timeline.span("download"):
+            assignments = np.asarray(p["assignments_dev"])
         p["solve_timer"].observe()
         b = p["b"]
         metrics.batch_size.observe(b)
@@ -972,12 +980,13 @@ class BatchScheduler(Scheduler):
                 np.add.at(req_s, rows_placed, p["req"][:b][placed])
                 np.add.at(nzr_s, rows_placed, p["nzr"][:b][placed])
                 ds.shadow_gens.append((req_s, nzr_s))
-        self._commit_batch(
-            p["solver_infos"], p["order"], assignments, p["names"],
-            p["num_nodes"], p["snapshot"], p["cycle"],
-            mask_info=(p.get("mask_rows"), p.get("mask_index_solved")),
-            gang_failed_uids=p.get("gang_failed_uids"),
-        )
+        with timeline.span("commit_batch"):
+            self._commit_batch(
+                p["solver_infos"], p["order"], assignments, p["names"],
+                p["num_nodes"], p["snapshot"], p["cycle"],
+                mask_info=(p.get("mask_rows"), p.get("mask_index_solved")),
+                gang_failed_uids=p.get("gang_failed_uids"),
+            )
 
     # -- batched commit ------------------------------------------------------
 
@@ -1053,12 +1062,14 @@ class BatchScheduler(Scheduler):
         bulk: List[Tuple] = []
         deferred: List[Tuple] = []  # sync-mode Permit waiters
         if plain:
-            clones = []
-            for pi, host in plain:
-                assumed = pi.pod.assumed_clone()
-                assumed.spec.node_name = host
-                clones.append(assumed)
-            errs = self.cache.assume_pods(clones)
+            with timeline.span("commit.clone"):
+                clones = []
+                for pi, host in plain:
+                    assumed = pi.pod.assumed_clone()
+                    assumed.spec.node_name = host
+                    clones.append(assumed)
+            with timeline.span("commit.assume"):
+                errs = self.cache.assume_pods(clones)
             self.queue.delete_nominated_pods_if_exist(clones)
             for (pi, host), assumed, err in zip(plain, clones, errs):
                 if err is not None:
@@ -1244,7 +1255,8 @@ class BatchScheduler(Scheduler):
             for _, _, _, assumed, host in ready
         ]
         bind_timer = metrics.SinceTimer(metrics.binding_duration)
-        results = self.client.bind_bulk(bindings)
+        with timeline.span("bind_bulk"):
+            results = self.client.bind_bulk(bindings)
         bind_timer.observe()
         bound = []
         for (prof, state, pi, assumed, host), (pod, err) in zip(ready, results):
@@ -1260,13 +1272,20 @@ class BatchScheduler(Scheduler):
             bound.append((prof, state, pi, assumed, host))
         if not bound:
             return
-        self.cache.finish_binding_bulk([a for _, _, _, a, _ in bound])
+        with timeline.span("finish_binding_bulk"):
+            self.cache.finish_binding_bulk([a for _, _, _, a, _ in bound])
         prof0 = bound[0][0]
         if prof0.has_plugins("post_bind"):
             for prof, state, pi, assumed, host in bound:
                 prof.run_post_bind_plugins(state, assumed, host)
         recorder = prof0.recorder
-        if hasattr(recorder, "eventf_many"):
+        with timeline.span("events+metrics"):
+            self._emit_bound(recorder, bound)
+
+    def _emit_bound(self, recorder, bound) -> None:
+        if hasattr(recorder, "scheduled_many"):
+            recorder.scheduled_many([a for _, _, _, a, _ in bound])
+        elif hasattr(recorder, "eventf_many"):
             recorder.eventf_many(
                 [
                     (

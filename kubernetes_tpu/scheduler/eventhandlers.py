@@ -181,9 +181,12 @@ def add_all_event_handlers(
                 adds.clear()
             if deletes:
                 sched.queue.delete_many(deletes)
-                for pod in deletes:
-                    for fw in sched.profiles.values():
-                        fw.reject_waiting_pod(pod.metadata.uid)
+                # bound-pod echoes almost never have Permit waiters --
+                # skip the per-pod reject loop when no profile holds any
+                if any(fw.waiting_pods for fw in sched.profiles.values()):
+                    for pod in deletes:
+                        for fw in sched.profiles.values():
+                            fw.reject_waiting_pod(pod.metadata.uid)
                 deletes.clear()
 
         for etype, old, new in frame:

@@ -43,6 +43,7 @@ from kubernetes_tpu.cache.node_info import (
     NodeInfo,
     Resource,
     non_zero_requests,
+    pod_hot_info,
 )
 from kubernetes_tpu.cache.snapshot import Snapshot
 from kubernetes_tpu.tensors.encoding import TopologyEncoder
@@ -302,26 +303,58 @@ def pack_pod_batch(
     growing the dim set mid-batch (which would shape-mismatch the
     already-packed node tensor)."""
     b = len(pods)
-    requests = np.zeros((b, dims.num_dims), dtype=np.int32)
-    nzr = np.zeros((b, 2), dtype=np.int32)
-    priorities = np.zeros(b, dtype=np.int32)
-    unsatisfiable = np.zeros(b, dtype=bool)
+    # Content-deduplicated encode: a burst is overwhelmingly homogeneous
+    # (a deployment scale-up packs thousands of identical specs), so
+    # encode each DISTINCT request map once and gather rows vectorized --
+    # the per-pod np.zeros + column-write loop was ~60% of pack time.
+    row_cache: Dict[Tuple, int] = {}
+    uniq_rows: List[np.ndarray] = []
+    uniq_unknown: List[bool] = []
+    idx = np.empty(b, dtype=np.int32)
+    nzr = np.empty((b, 2), dtype=np.int32)
+    prio_list = [0] * b
     for i, pod in enumerate(pods):
-        row, unknown = dims.encode_requests(
-            pod_resource_requests(pod), grow=False
-        )
-        row[PODS] = 1
-        requests[i] = row
-        unsatisfiable[i] = unknown
+        req = pod_resource_requests(pod)
+        # prime the accounting memo on the ORIGINAL pod here: the commit
+        # path's assume/bind clones copy __dict__, so the memo rides into
+        # every clone and NodeInfo.add_pod never re-derives it
+        pod_hot_info(pod)
+        key = tuple(req.items())
+        u = row_cache.get(key)
+        if u is None:
+            row, unknown = dims.encode_requests(req, grow=False)
+            row[PODS] = 1
+            u = len(uniq_rows)
+            uniq_rows.append(row)
+            uniq_unknown.append(unknown)
+            row_cache[key] = u
+        idx[i] = u
         cpu, mem = non_zero_requests(pod)
         nzr[i, 0] = cpu
         nzr[i, 1] = _kib_ceil(mem)
-        priorities[i] = pod.spec.priority
+        prio_list[i] = pod.spec.priority
+    if uniq_rows:
+        requests = np.stack(uniq_rows)[idx]
+        unsatisfiable = np.asarray(uniq_unknown, dtype=bool)[idx]
+    else:  # empty batch: preserve the [0, R] contract
+        requests = np.zeros((0, dims.num_dims), dtype=np.int32)
+        unsatisfiable = np.zeros(0, dtype=bool)
+    priorities = np.asarray(prio_list, dtype=np.int32)
     ts = timestamps or [pod.metadata.creation_timestamp for pod in pods]
-    order = np.array(
-        sorted(range(b), key=lambda i: (-int(priorities[i]), ts[i])),
-        dtype=np.int32,
-    )
+    # pop_batch already drains the activeQ in comparator order (priority
+    # desc, enqueue time asc) -- detect the sorted common case and skip
+    # the Python sort
+    if all(
+        prio_list[i] > prio_list[i + 1]
+        or (prio_list[i] == prio_list[i + 1] and ts[i] <= ts[i + 1])
+        for i in range(b - 1)
+    ):
+        order = np.arange(b, dtype=np.int32)
+    else:
+        order = np.array(
+            sorted(range(b), key=lambda i: (-prio_list[i], ts[i])),
+            dtype=np.int32,
+        )
     return PodBatch(
         pods=list(pods),
         requests=requests,

@@ -92,6 +92,14 @@ class EventBroadcaster:
         for item in items:
             source, obj, event_type, reason, message = item
             meta = obj.metadata
+            if message is None and reason == "Scheduled":
+                # deferred formatting: the commit hot path enqueues the
+                # bare (pod, host) and the message f-string renders HERE,
+                # off the scheduling threads (host rides spec.node_name)
+                message = (
+                    f"Successfully assigned {meta.namespace}/{meta.name} "
+                    f"to {obj.spec.node_name}"
+                )
             key = (meta.uid, reason, message)
             stored = self._aggregate.get(key)
             if stored is not None:
@@ -166,10 +174,20 @@ class EventRecorder:
 
     def eventf_many(self, items) -> None:
         """Bulk enqueue under one lock: items = [(obj, type, reason,
-        message)] (the batch commit's per-burst Scheduled events)."""
+        message)] (the batch commit's per-burst Scheduled events).
+        ``message=None`` with reason "Scheduled" defers formatting to the
+        broadcaster thread."""
         src = self.source
         self._broadcaster._enqueue_many(
             [(src, obj, t, r, m) for obj, t, r, m in items]
+        )
+
+    def scheduled_many(self, bound_pods) -> None:
+        """Zero-format enqueue for the burst commit: one tuple per bound
+        pod, message rendered on the broadcaster thread."""
+        src = self.source
+        self._broadcaster._enqueue_many(
+            [(src, pod, "Normal", "Scheduled", None) for pod in bound_pods]
         )
 
 

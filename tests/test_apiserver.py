@@ -97,3 +97,54 @@ def test_update_pod_status():
 
     updated = client.update_pod_status("default", "p1", nominate)
     assert updated.status.nominated_node_name == "n5"
+
+
+def test_update_invalidates_scheduler_memos():
+    """Memoized per-pod scheduler state (_sig_memo/_hot_memo/_req_memo)
+    must not survive a guaranteed_update: the mutate may change exactly
+    the fields the memos were derived from (the code-review r4 repro: a
+    toleration added post-parking kept the pod masked off tainted nodes
+    forever)."""
+    from kubernetes_tpu.api.types import Toleration, pod_resource_requests
+    from kubernetes_tpu.ops.host_masks import _constraint_signature
+
+    api = APIServer()
+    client = Client(api)
+    pod = make_pod("p1").container(cpu="100m", memory="64Mi").obj()
+    client.create_pod(pod)
+    # prime every memo the scheduler hot path writes
+    pod_resource_requests(pod)
+    sig_before = _constraint_signature(pod)
+    assert sig_before[3] == ()  # no tolerations
+
+    def add_toleration(p):
+        p.spec.tolerations = [
+            Toleration(key="dedicated", operator="Exists")
+        ]
+
+    updated = api.guaranteed_update("Pod", "default", "p1", add_toleration)
+    sig_after = _constraint_signature(updated)
+    assert sig_after[3] != (), "signature memo leaked through the update"
+    req = pod_resource_requests(updated)
+    assert req  # recomputed, not a stale shared memo
+
+
+def test_bind_invalidates_signature_memo():
+    """_constraint_signature includes spec.node_name; the binding path
+    must drop the memo (resource memos may legitimately survive -- bind
+    only writes node_name)."""
+    from kubernetes_tpu.ops.host_masks import _constraint_signature
+
+    api = APIServer()
+    client = Client(api)
+    pod = make_pod("p2").obj()
+    client.create_pod(pod)
+    assert _constraint_signature(pod)[0] == ""
+    client.bind(
+        Binding(
+            pod_namespace="default", pod_name="p2",
+            pod_uid=pod.metadata.uid, target_node="n1",
+        )
+    )
+    bound = api.get("Pod", "default", "p2")
+    assert _constraint_signature(bound)[0] == "n1"

@@ -118,3 +118,12 @@ def test_non_zero_defaults_in_batch():
     assert batch.non_zero_requests[0, 0] == 100
     assert batch.non_zero_requests[0, 1] == 200 * 1024
     assert batch.requests[0, PODS] == 1
+
+
+def test_pack_pod_batch_empty():
+    from kubernetes_tpu.tensors.node_tensor import ResourceDims, pack_pod_batch
+
+    batch = pack_pod_batch([], ResourceDims())
+    assert batch.size == 0
+    assert batch.requests.shape == (0, ResourceDims().num_dims)
+    assert batch.order.shape == (0,)
