@@ -153,7 +153,8 @@ def main() -> None:
     create_times = {}
     # parallel creators: the burst arrives through the API as fast as the
     # store can take it, overlapping serialization with the solve pipeline
-    n_creators = 4
+    # (on a single-core host extra creator threads only add GIL ping-pong)
+    n_creators = min(4, os.cpu_count() or 4)
     shards = [burst[i::n_creators] for i in range(n_creators)]
 
     def create_shard(shard):

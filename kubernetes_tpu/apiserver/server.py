@@ -28,8 +28,10 @@ from kubernetes_tpu.api.types import Binding, Node, Pod
 
 try:
     from kubernetes_tpu.native import cow_clone as _cow_clone
+    from kubernetes_tpu.native import bind_assumed_bulk as _bind_assumed_bulk
 except Exception:  # noqa: BLE001 - pure-Python fallback
     _cow_clone = None
+    _bind_assumed_bulk = None
 
 _POD_COW_ATTRS = ("metadata", "spec", "status")
 
@@ -59,7 +61,7 @@ class Conflict(ValueError):
     pass
 
 
-@dataclass
+@dataclass(slots=True)
 class WatchEvent:
     type: str  # ADDED | MODIFIED | DELETED
     object: Any
@@ -427,6 +429,58 @@ class APIServer:
                     out.append((None, e))
             self._broadcast_many("Pod", events)
         return out
+
+    def bind_assumed_bulk(
+        self, assumed_pods: List[Pod]
+    ) -> List[Tuple[int, Exception]]:
+        """Bulk bind commit driven directly by the scheduler's assumed
+        clones (metadata carries namespace/name/uid, spec.node_name the
+        target) -- the allocation-free fast path of ``bind_bulk``: no
+        Binding objects, no per-slot result tuples. Returns only the
+        failed slots as (index, error); an empty list means every pod
+        bound. The whole transaction runs under one store lock with one
+        bulk watch fan-out, through the native C loop when available
+        (native/_hotpath.c bind_assumed_bulk)."""
+        with self._lock:
+            if _bind_assumed_bulk is not None:
+                errors, events, new_rv = _bind_assumed_bulk(
+                    self._stores["Pod"], assumed_pods, self._rv, WatchEvent
+                )
+                self._rv = new_rv
+                self._broadcast_many("Pod", events)
+                if not errors:
+                    return []
+                out: List[Tuple[int, Exception]] = []
+                for idx, code, msg in errors:
+                    exc: Exception
+                    if code == 0:
+                        exc = NotFound(msg)
+                    elif code == 1:
+                        exc = Conflict(msg)
+                    elif code == 2:
+                        exc = ValueError(msg)
+                    else:
+                        exc = RuntimeError(msg)
+                    out.append((idx, exc))
+                return out
+            # pure-Python fallback: delegate to the shared bind_bulk
+            # transaction (one loop to maintain) and convert its per-slot
+            # results to the failures-only shape
+            results = self.bind_bulk(
+                [
+                    Binding(
+                        pod_namespace=a.metadata.namespace,
+                        pod_name=a.metadata.name,
+                        pod_uid=a.metadata.uid,
+                        target_node=a.spec.node_name,
+                    )
+                    for a in assumed_pods
+                ]
+            )
+            return [
+                (i, err) for i, (_pod, err) in enumerate(results)
+                if err is not None
+            ]
 
     # -- pod status subresource ---------------------------------------------
 

@@ -52,6 +52,11 @@ class Client:
         (pod, error) pair per binding."""
         return self._server.bind_bulk(bindings)
 
+    def bind_assumed_bulk(self, assumed_pods: List[Pod]):
+        """Allocation-free bulk bind from assumed clones; returns only
+        the failed slots as (index, error)."""
+        return self._server.bind_assumed_bulk(assumed_pods)
+
     def update_pod_status(
         self, namespace: str, name: str, mutate: Callable[[Pod], None]
     ) -> Pod:

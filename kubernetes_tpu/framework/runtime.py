@@ -155,6 +155,12 @@ class Framework:
         like hard_pod_affinity_weight from it)."""
         return self._instances.get(name)
 
+    def relevance_entries(self, point: str):
+        """The (plugin, relevance) table behind ``plugins_relevant`` --
+        an empty table means plugins_relevant is False for EVERY pod, so
+        batch hot loops hoist the check and skip the per-pod call."""
+        return self._relevance[point]
+
     def plugins_relevant(self, point: str, pod: Pod) -> bool:
         """True when at least one plugin at ``point`` may act on this pod
         (no relevance predicate counts as always-relevant)."""

@@ -75,3 +75,6 @@ except Exception:  # noqa: BLE001 - pure-Python fallback
 #: ``from kubernetes_tpu.native import cow_clone`` and fall back to
 #: copy.copy chains when it is None (build/import failure, stale .so)
 cow_clone = getattr(hotpath, "cow_clone", None)
+#: one-call commit-path loops (see _hotpath.c "bulk commit spine")
+assume_clones = getattr(hotpath, "assume_clones", None)
+bind_assumed_bulk = getattr(hotpath, "bind_assumed_bulk", None)

@@ -250,6 +250,431 @@ fail:
     return NULL;
 }
 
+/* -- bulk commit spine ---------------------------------------------------
+ *
+ * The 10k-burst commit window spends most of its host budget in two
+ * per-pod loops: (a) assumed_clone + spec.node_name per committed pod
+ * (batch.py commit.clone) and (b) the apiserver bind transaction
+ * (server.py bind_bulk: lookup, uid/bound checks, cow clone, rv bump,
+ * store write, watch-event build). Both are pure object-graph work with
+ * no Python-level semantics beyond dict/attr ops, so they live here as
+ * single C loops: assume_clones() and bind_assumed_bulk(). The Python
+ * fallbacks (api/types.py assumed_clone, server.py _bind_locked) carry
+ * the same semantics; tests/test_native_commit.py differentially
+ * exercises native vs fallback on the same inputs.
+ */
+
+static PyObject *str_spec = NULL;
+static PyObject *str_node_name = NULL;
+static PyObject *str_metadata = NULL;
+static PyObject *str_namespace = NULL;
+static PyObject *str_name = NULL;
+static PyObject *str_uid = NULL;
+static PyObject *str_resource_version = NULL;
+static PyObject *str_sig_memo = NULL;
+static PyObject *str_modified = NULL;
+
+/* Install dict `dc` (reference stolen) as `obj`'s instance dict via the
+ * dict pointer when the layout allows it, else through the __dict__
+ * descriptor. Returns 0 ok / -1 error (dc released either way). */
+static int
+install_dict(PyObject *obj, PyObject *dc)
+{
+    PyObject **dp = _PyObject_GetDictPtr(obj);
+    if (dp != NULL) {
+        Py_XSETREF(*dp, dc);
+        return 0;
+    }
+    int r = PyObject_SetAttr(obj, str_dict, dc);
+    Py_DECREF(dc);
+    return r;
+}
+
+/* Shallow-clone obj by dict copy; optionally override one key in (and/or
+ * drop one key from) the copied dict before installing it. */
+static PyObject *
+clone_with_dict(PyObject *obj, PyObject *override_key, PyObject *override_val,
+                PyObject *drop_key)
+{
+    PyTypeObject *tp = Py_TYPE(obj);
+    PyObject *new = tp->tp_alloc(tp, 0);
+    if (new == NULL)
+        return NULL;
+    PyObject *d = PyObject_GetAttr(obj, str_dict);
+    if (d == NULL) {
+        Py_DECREF(new);
+        return NULL;
+    }
+    PyObject *dc = PyDict_Copy(d);
+    Py_DECREF(d);
+    if (dc == NULL) {
+        Py_DECREF(new);
+        return NULL;
+    }
+    if (override_key != NULL &&
+        PyDict_SetItem(dc, override_key, override_val) < 0) {
+        Py_DECREF(dc);
+        Py_DECREF(new);
+        return NULL;
+    }
+    if (drop_key != NULL && PyDict_Contains(dc, drop_key) == 1 &&
+        PyDict_DelItem(dc, drop_key) < 0) {
+        Py_DECREF(dc);
+        Py_DECREF(new);
+        return NULL;
+    }
+    if (install_dict(new, dc) < 0) {
+        Py_DECREF(new);
+        return NULL;
+    }
+    return new;
+}
+
+static PyObject *
+assume_clones(PyObject *self, PyObject *args)
+{
+    /* assume_clones(pods, hosts) -> [clone] where clone = shallow pod
+     * with shallow spec and spec.node_name = host (the one-call form of
+     * Pod.assumed_clone() + node_name assignment per committed pod). */
+    PyObject *pods, *hosts;
+    if (!PyArg_ParseTuple(args, "O!O!", &PyList_Type, &pods,
+                          &PyList_Type, &hosts))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(pods);
+    if (PyList_GET_SIZE(hosts) != n) {
+        PyErr_SetString(PyExc_ValueError, "pods/hosts length mismatch");
+        return NULL;
+    }
+    PyObject *out = PyList_New(n);
+    if (out == NULL)
+        return NULL;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *pod = PyList_GET_ITEM(pods, i);
+        PyObject *host = PyList_GET_ITEM(hosts, i);
+        PyObject *spec = PyObject_GetAttr(pod, str_spec);
+        if (spec == NULL)
+            goto fail;
+        PyObject *specc = clone_with_dict(spec, str_node_name, host, NULL);
+        Py_DECREF(spec);
+        if (specc == NULL)
+            goto fail;
+        PyObject *podc = clone_with_dict(pod, str_spec, specc, NULL);
+        Py_DECREF(specc);
+        if (podc == NULL)
+            goto fail;
+        PyList_SET_ITEM(out, i, podc);
+    }
+    return out;
+fail:
+    Py_DECREF(out);
+    return NULL;
+}
+
+static PyObject *
+bind_assumed_bulk(PyObject *self, PyObject *args)
+{
+    /* bind_assumed_bulk(store, assumed_list, rv, event_cls)
+     *   -> (errors, events, new_rv)
+     *
+     * One C pass over the whole bulk-bind transaction (caller holds the
+     * store lock). Per slot, semantics match server._bind_locked: lookup
+     * by (namespace, name), uid check, already-bound check, target
+     * check, copy-on-write clone of the STORED pod (metadata+spec;
+     * status stays shared -- see inline note) with spec.node_name set,
+     * _sig_memo dropped, resource_version assigned sequentially from
+     * rv+1. errors = [(index, code, msg)] with code 0=NotFound
+     * 1=Conflict 2=ValueError 3=internal; events = [event_cls(MODIFIED,
+     * pod, rv)] for the successes, in store order. Per-slot failures
+     * (including unexpected ones) never abort the slots already
+     * committed. Differential parity with the Python fallback:
+     * tests/test_native_commit.py. */
+    PyObject *store, *assumed_list, *event_cls;
+    long rv;
+    if (!PyArg_ParseTuple(args, "O!O!lO", &PyDict_Type, &store,
+                          &PyList_Type, &assumed_list, &rv, &event_cls))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(assumed_list);
+    PyObject *errors = PyList_New(0);
+    PyObject *events = PyList_New(0);
+    if (errors == NULL || events == NULL) {
+        Py_XDECREF(errors);
+        Py_XDECREF(events);
+        return NULL;
+    }
+
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *assumed = PyList_GET_ITEM(assumed_list, i);
+        PyObject *meta = NULL, *ns = NULL, *name = NULL, *uid = NULL;
+        PyObject *spec = NULL, *target = NULL, *key = NULL;
+        int errcode = -1;
+        int rv_bumped = 0;
+        const char *errfmt = NULL;
+
+        meta = PyObject_GetAttr(assumed, str_metadata);
+        if (meta == NULL)
+            goto hard_fail;
+        ns = PyObject_GetAttr(meta, str_namespace);
+        name = PyObject_GetAttr(meta, str_name);
+        uid = PyObject_GetAttr(meta, str_uid);
+        Py_DECREF(meta);
+        if (ns == NULL || name == NULL || uid == NULL)
+            goto hard_fail;
+        spec = PyObject_GetAttr(assumed, str_spec);
+        if (spec == NULL)
+            goto hard_fail;
+        target = PyObject_GetAttr(spec, str_node_name);
+        Py_DECREF(spec);
+        if (target == NULL)
+            goto hard_fail;
+
+        key = PyTuple_Pack(2, ns, name);
+        if (key == NULL)
+            goto hard_fail;
+        PyObject *old = PyDict_GetItemWithError(store, key); /* borrowed */
+        if (old == NULL) {
+            if (PyErr_Occurred())
+                goto hard_fail;
+            errcode = 0;
+            errfmt = "Pod %U/%U not found";
+            goto slot_error;
+        }
+
+        PyObject *old_meta = PyObject_GetAttr(old, str_metadata);
+        if (old_meta == NULL)
+            goto hard_fail;
+        PyObject *old_uid = PyObject_GetAttr(old_meta, str_uid);
+        if (old_uid == NULL) {
+            Py_DECREF(old_meta);
+            goto hard_fail;
+        }
+        int uid_true = PyObject_IsTrue(uid);
+        if (uid_true > 0) {
+            int eq = PyObject_RichCompareBool(old_uid, uid, Py_EQ);
+            if (eq < 0) {
+                Py_DECREF(old_uid);
+                Py_DECREF(old_meta);
+                goto hard_fail;
+            }
+            if (!eq) {
+                Py_DECREF(old_uid);
+                Py_DECREF(old_meta);
+                errcode = 1;
+                errfmt = "pod %U/%U uid mismatch";
+                goto slot_error;
+            }
+        } else if (uid_true < 0) {
+            Py_DECREF(old_uid);
+            Py_DECREF(old_meta);
+            goto hard_fail;
+        }
+        Py_DECREF(old_uid);
+
+        PyObject *old_spec = PyObject_GetAttr(old, str_spec);
+        if (old_spec == NULL) {
+            Py_DECREF(old_meta);
+            goto hard_fail;
+        }
+        PyObject *old_nn = PyObject_GetAttr(old_spec, str_node_name);
+        if (old_nn == NULL) {
+            Py_DECREF(old_spec);
+            Py_DECREF(old_meta);
+            goto hard_fail;
+        }
+        int bound = PyObject_IsTrue(old_nn);
+        if (bound > 0) {
+            int same = PyObject_RichCompareBool(old_nn, target, Py_EQ);
+            if (same < 0) {
+                Py_DECREF(old_nn);
+                Py_DECREF(old_spec);
+                Py_DECREF(old_meta);
+                goto hard_fail;
+            }
+            if (!same) {
+                Py_DECREF(old_nn);
+                Py_DECREF(old_spec);
+                Py_DECREF(old_meta);
+                errcode = 1;
+                errfmt = "pod %U/%U is already bound";
+                goto slot_error;
+            }
+        } else if (bound < 0) {
+            Py_DECREF(old_nn);
+            Py_DECREF(old_spec);
+            Py_DECREF(old_meta);
+            goto hard_fail;
+        }
+        Py_DECREF(old_nn);
+
+        /* target required -- checked LAST, matching _bind_locked's
+         * check order (uid, already-bound, then target) */
+        int target_true = PyObject_IsTrue(target);
+        if (target_true < 0) {
+            Py_DECREF(old_spec);
+            Py_DECREF(old_meta);
+            goto hard_fail;
+        }
+        if (!target_true) {
+            Py_DECREF(old_spec);
+            Py_DECREF(old_meta);
+            errcode = 2;
+            errfmt = "binding for %U/%U has no target node";
+            goto slot_error;
+        }
+
+        /* success: COW clone of the stored pod */
+        rv += 1;
+        rv_bumped = 1;
+        PyObject *rv_obj = PyLong_FromLong(rv);
+        if (rv_obj == NULL) {
+            Py_DECREF(old_spec);
+            Py_DECREF(old_meta);
+            goto hard_fail;
+        }
+        /* status stays SHARED between old and new: every status write
+         * goes through guaranteed_update/update_pod_status, which clone
+         * status themselves before mutating (the informer read-only
+         * contract makes the shared reference safe). */
+        PyObject *metac =
+            clone_with_dict(old_meta, str_resource_version, rv_obj, NULL);
+        Py_DECREF(old_meta);
+        PyObject *specc =
+            clone_with_dict(old_spec, str_node_name, target, NULL);
+        Py_DECREF(old_spec);
+        if (metac == NULL || specc == NULL) {
+            Py_XDECREF(metac);
+            Py_XDECREF(specc);
+            Py_DECREF(rv_obj);
+            goto hard_fail;
+        }
+
+        PyTypeObject *tp = Py_TYPE(old);
+        PyObject *podc = tp->tp_alloc(tp, 0);
+        PyObject *d = podc ? PyObject_GetAttr(old, str_dict) : NULL;
+        PyObject *dc = d ? PyDict_Copy(d) : NULL;
+        Py_XDECREF(d);
+        int ok = podc != NULL && dc != NULL &&
+                 PyDict_SetItem(dc, str_metadata, metac) == 0 &&
+                 PyDict_SetItem(dc, str_spec, specc) == 0;
+        if (ok && PyDict_Contains(dc, str_sig_memo) == 1)
+            ok = PyDict_DelItem(dc, str_sig_memo) == 0;
+        if (ok) {
+            ok = install_dict(podc, dc) == 0;
+            dc = NULL; /* reference consumed by install_dict */
+        }
+        Py_XDECREF(dc);
+        Py_DECREF(metac);
+        Py_DECREF(specc);
+        if (!ok) {
+            Py_XDECREF(podc);
+            Py_DECREF(rv_obj);
+            goto hard_fail;
+        }
+        /* event BEFORE the store write: a failure here leaves the slot
+         * (and the store) untouched, so the transaction stays
+         * event-consistent per slot */
+        PyObject *event = PyObject_CallFunctionObjArgs(
+            event_cls, str_modified, podc, rv_obj, NULL);
+        Py_DECREF(rv_obj);
+        if (event == NULL) {
+            Py_DECREF(podc);
+            goto hard_fail;
+        }
+        Py_INCREF(old); /* keep alive across the store replace for rollback */
+        if (PyDict_SetItem(store, key, podc) < 0) {
+            Py_DECREF(old);
+            Py_DECREF(podc);
+            Py_DECREF(event);
+            goto hard_fail;
+        }
+        int ap = PyList_Append(events, event);
+        Py_DECREF(event);
+        if (ap < 0) {
+            /* roll the slot back so store and events stay consistent */
+            if (PyDict_SetItem(store, key, old) < 0)
+                PyErr_Clear();
+            Py_DECREF(old);
+            Py_DECREF(podc);
+            goto hard_fail;
+        }
+        Py_DECREF(old);
+        Py_DECREF(podc);
+        Py_DECREF(key);
+        Py_DECREF(ns);
+        Py_DECREF(name);
+        Py_DECREF(uid);
+        Py_DECREF(target);
+        continue;
+
+    slot_error: {
+        PyObject *msg = PyUnicode_FromFormat(errfmt, ns, name);
+        PyObject *slot =
+            msg ? Py_BuildValue("(niN)", i, errcode, msg) : NULL;
+        Py_XDECREF(key);
+        Py_DECREF(ns);
+        Py_DECREF(name);
+        Py_DECREF(uid);
+        Py_DECREF(target);
+        if (slot == NULL)
+            goto abort_fail;
+        int ap = PyList_Append(errors, slot);
+        Py_DECREF(slot);
+        if (ap < 0)
+            goto abort_fail;
+        continue;
+    }
+
+    hard_fail: {
+        /* An unexpected per-slot failure (allocation, broken attribute)
+         * must NOT abort the transaction: earlier slots already mutated
+         * the store and their watch events/rv advance must still reach
+         * the caller. Convert to a slot error (code 3) and continue;
+         * the failed slot itself left the store untouched -- including
+         * its provisional rv, matching the Python path where _next_rv
+         * only runs after validation. */
+        if (rv_bumped)
+            rv -= 1;
+        Py_XDECREF(key);
+        Py_XDECREF(ns);
+        Py_XDECREF(name);
+        Py_XDECREF(uid);
+        Py_XDECREF(target);
+        PyObject *et = NULL, *ev = NULL, *tb = NULL;
+        PyErr_Fetch(&et, &ev, &tb);
+        PyObject *msg = NULL;
+        if (ev != NULL)
+            msg = PyObject_Str(ev);
+        else if (et != NULL)
+            msg = PyObject_Str(et);
+        else
+            msg = PyUnicode_FromString("internal bind error");
+        Py_XDECREF(et);
+        Py_XDECREF(ev);
+        Py_XDECREF(tb);
+        if (msg == NULL)
+            goto abort_fail;
+        PyObject *slot = Py_BuildValue("(niN)", i, 3, msg);
+        if (slot == NULL)
+            goto abort_fail;
+        int ap = PyList_Append(errors, slot);
+        Py_DECREF(slot);
+        if (ap < 0)
+            goto abort_fail;
+        continue;
+    }
+
+    abort_fail:
+        /* only reachable when even recording the error fails (OOM on
+         * OOM); nothing sensible left to report */
+        PyErr_Clear();
+        PyErr_SetString(PyExc_MemoryError,
+                        "bind_assumed_bulk: cannot record slot error");
+        Py_DECREF(errors);
+        Py_DECREF(events);
+        return NULL;
+    }
+    return Py_BuildValue("(NNl)", errors, events, rv);
+}
+
 static PyMethodDef methods[] = {
     {"match_compiled", match_compiled, METH_VARARGS,
      "match_compiled(labels, compiled) -> bool"},
@@ -260,6 +685,12 @@ static PyMethodDef methods[] = {
     {"cow_clone", cow_clone, METH_VARARGS,
      "cow_clone(obj, attr_names) -> shallow clone with named attrs "
      "also shallow-cloned"},
+    {"assume_clones", assume_clones, METH_VARARGS,
+     "assume_clones(pods, hosts) -> [assumed clone with spec.node_name "
+     "set]"},
+    {"bind_assumed_bulk", bind_assumed_bulk, METH_VARARGS,
+     "bind_assumed_bulk(store, assumed_list, rv, event_cls) -> "
+     "(errors, events, new_rv)"},
     {NULL, NULL, 0, NULL},
 };
 
@@ -273,7 +704,19 @@ PyMODINIT_FUNC
 PyInit__hotpath(void)
 {
     str_dict = PyUnicode_InternFromString("__dict__");
-    if (str_dict == NULL)
+    str_spec = PyUnicode_InternFromString("spec");
+    str_node_name = PyUnicode_InternFromString("node_name");
+    str_metadata = PyUnicode_InternFromString("metadata");
+    str_namespace = PyUnicode_InternFromString("namespace");
+    str_name = PyUnicode_InternFromString("name");
+    str_uid = PyUnicode_InternFromString("uid");
+    str_resource_version = PyUnicode_InternFromString("resource_version");
+    str_sig_memo = PyUnicode_InternFromString("_sig_memo");
+    str_modified = PyUnicode_InternFromString("MODIFIED");
+    if (str_dict == NULL || str_spec == NULL || str_node_name == NULL ||
+        str_metadata == NULL || str_namespace == NULL ||
+        str_name == NULL || str_uid == NULL || str_resource_version == NULL ||
+        str_sig_memo == NULL || str_modified == NULL)
         return NULL;
     return PyModule_Create(&moduledef);
 }

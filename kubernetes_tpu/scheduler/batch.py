@@ -78,6 +78,11 @@ from kubernetes_tpu.tensors import NodeTensorCache, pack_pod_batch
 from kubernetes_tpu.utils import metrics
 from kubernetes_tpu.utils import timeline
 
+try:
+    from kubernetes_tpu.native import assume_clones as _assume_clones
+except Exception:  # noqa: BLE001 - pure-Python fallback
+    _assume_clones = None
+
 logger = logging.getLogger(__name__)
 
 POD_BUCKET = 64  # batch padded to a multiple of this to bound re-JITs
@@ -214,6 +219,9 @@ class BatchScheduler(Scheduler):
         self._pending_cv = threading.Condition()
         self._committer: Optional[threading.Thread] = None
         self._committer_stop = False
+        # collect-at-idle gc policy, engaged only by the production run
+        # loop (tests driving schedule_batch directly keep gc untouched)
+        self._gc_guard = None
 
     # -- one batch ----------------------------------------------------------
 
@@ -233,10 +241,15 @@ class BatchScheduler(Scheduler):
         batch_infos = self.queue.pop_batch(
             self.max_batch, timeout=timeout, window=self.batch_window
         )
+        guard = self._gc_guard
         if not batch_infos:
             # idle: finish whatever is still in flight
             self._drain_pending()
+            if guard is not None:
+                guard.idle()
             return 0
+        if guard is not None:
+            guard.active()
         pod_scheduling_cycle = self.queue.scheduling_cycle
 
         # Process in activeQ order: a fallback pod must not jump ahead of
@@ -1025,12 +1038,21 @@ class BatchScheduler(Scheduler):
         bulk_ok = (
             prof.uses_default_binder_only() and self._bind_pool is not None
         )
+        # hoisted out of the per-pod loop: numpy scalar -> int conversion
+        # in one C pass, binder extenders (normally none), and the
+        # relevance tables (empty table => plugins_relevant is False for
+        # every pod, no call needed)
+        order_l = order.tolist()
+        assign_l = assignments.tolist()
+        binder_extenders = [e for e in extenders if e.is_binder()]
+        reserve_maybe = prof.relevance_entries("reserve")
+        permit_maybe = prof.relevance_entries("permit")
 
         plain: List[Tuple[PodInfo, str]] = []  # (pod_info, host)
         slow: List[Tuple[PodInfo, int, int]] = []  # (pod_info, choice, k)
         for k in range(b):
-            pi = solver_infos[int(order[k])]
-            choice = int(assignments[k])
+            pi = solver_infos[order_l[k]]
+            choice = assign_l[k]
             if gang_failed_uids and pi.pod.metadata.uid in gang_failed_uids:
                 # quorum-masked gang member: no placement, no preemption
                 # (the group chose not to place; a PodGroupMemberAdd
@@ -1049,10 +1071,16 @@ class BatchScheduler(Scheduler):
             pod = pi.pod
             if (
                 bulk_ok
-                and not prof.plugins_relevant("reserve", pod)
-                and not prof.plugins_relevant("permit", pod)
-                and not any(
-                    e.is_binder() and e.is_interested(pod) for e in extenders
+                and not (
+                    reserve_maybe
+                    and prof.plugins_relevant("reserve", pod)
+                )
+                and not (
+                    permit_maybe and prof.plugins_relevant("permit", pod)
+                )
+                and not (
+                    binder_extenders
+                    and any(e.is_interested(pod) for e in binder_extenders)
                 )
             ):
                 plain.append((pi, names[choice]))
@@ -1063,26 +1091,35 @@ class BatchScheduler(Scheduler):
         deferred: List[Tuple] = []  # sync-mode Permit waiters
         if plain:
             with timeline.span("commit.clone"):
-                clones = []
-                for pi, host in plain:
-                    assumed = pi.pod.assumed_clone()
-                    assumed.spec.node_name = host
-                    clones.append(assumed)
+                if _assume_clones is not None:
+                    clones = _assume_clones(
+                        [pi.pod for pi, _ in plain],
+                        [host for _, host in plain],
+                    )
+                else:
+                    clones = []
+                    for pi, host in plain:
+                        assumed = pi.pod.assumed_clone()
+                        assumed.spec.node_name = host
+                        clones.append(assumed)
             with timeline.span("commit.assume"):
                 errs = self.cache.assume_pods(clones)
             self.queue.delete_nominated_pods_if_exist(clones)
-            for (pi, host), assumed, err in zip(plain, clones, errs):
-                if err is not None:
-                    self.record_scheduling_failure(
-                        prof, pi, str(err), "SchedulerError", "",
-                        pod_scheduling_cycle,
-                    )
-                    continue
-                # fresh CycleState per pod: pre_bind/unreserve/post_bind
-                # plugins may write per-pod state (the framework contract)
-                state = CycleState()
-                state.write(SNAPSHOT_STATE_KEY, snapshot)
-                bulk.append((prof, state, pi, assumed, host))
+            # CycleState is built lazily in the binding cycle (only
+            # pre_bind/unreserve/post_bind plugins and failure paths read
+            # it; the plain burst has none)
+            if any(errs):
+                for (pi, host), assumed, err in zip(plain, clones, errs):
+                    if err is not None:
+                        self.record_scheduling_failure(
+                            prof, pi, str(err), "SchedulerError", "",
+                            pod_scheduling_cycle,
+                        )
+                        continue
+                    bulk.append((prof, None, pi, assumed, host))
+            else:
+                for (pi, host), assumed in zip(plain, clones):
+                    bulk.append((prof, None, pi, assumed, host))
             self.pods_solved_on_device += len(plain)
 
         failed_group: List[Tuple[PodInfo, FitError]] = []
@@ -1204,7 +1241,8 @@ class BatchScheduler(Scheduler):
             with self._inflight_lock:
                 self._inflight_binds += 1
             self._bind_pool.submit(
-                self._bulk_binding_cycle_safe, bulk, pod_scheduling_cycle
+                self._bulk_binding_cycle_safe, bulk, pod_scheduling_cycle,
+                snapshot,
             )
         for prof_d, state_d, pi_d, assumed_d, host_d in deferred:
             self._binding_cycle(
@@ -1212,9 +1250,11 @@ class BatchScheduler(Scheduler):
                 pod_scheduling_cycle,
             )
 
-    def _bulk_binding_cycle_safe(self, items, pod_scheduling_cycle) -> None:
+    def _bulk_binding_cycle_safe(
+        self, items, pod_scheduling_cycle, snapshot=None
+    ) -> None:
         try:
-            self._bulk_binding_cycle(items, pod_scheduling_cycle)
+            self._bulk_binding_cycle(items, pod_scheduling_cycle, snapshot)
         except Exception:
             logger.exception("bulk binding cycle crashed")
         finally:
@@ -1222,62 +1262,87 @@ class BatchScheduler(Scheduler):
                 self._inflight_binds -= 1
                 self._inflight_lock.notify_all()
 
-    def _bulk_binding_cycle(self, items, pod_scheduling_cycle) -> None:
+    def _bulk_binding_cycle(
+        self, items, pod_scheduling_cycle, snapshot=None
+    ) -> None:
         """One API transaction commits the batch (the pipelined bulk
         analogue of BindingREST.Create, storage.go:142). PreBind still
         runs per pod (skipped when every PreBind plugin declares itself
         a no-op for the pod); per-binding conflicts fail only their own
-        pod."""
-        ready = []
-        for prof, state, pi, assumed, host in items:
-            if prof.plugins_relevant("pre_bind", assumed):
-                status = prof.run_pre_bind_plugins(state, assumed, host)
-            else:
-                status = None
-            if status is not None and not status.is_success():
-                self._forget(assumed)
-                prof.run_unreserve_plugins(state, assumed, host)
-                self.record_scheduling_failure(
-                    prof, pi, status.message(), "SchedulerError", "",
-                    pod_scheduling_cycle,
-                )
-                continue
-            ready.append((prof, state, pi, assumed, host))
-        if not ready:
-            return
-        bindings = [
-            Binding(
-                pod_namespace=assumed.metadata.namespace,
-                pod_name=assumed.metadata.name,
-                pod_uid=assumed.metadata.uid,
-                target_node=host,
-            )
-            for _, _, _, assumed, host in ready
-        ]
+        pod.
+
+        Plain pods arrive with ``state is None``: a CycleState is built
+        only on the paths that read one (relevant pre_bind/post_bind
+        plugins, unreserve on failure) -- the framework contract is
+        per-pod state, and a fresh snapshot-seeded state is exactly what
+        the eager path carried for these pods."""
+        prof0 = items[0][0]
+
+        def mk_state():
+            state = CycleState()
+            state.write(SNAPSHOT_STATE_KEY, snapshot)
+            return state
+
+        if prof0.relevance_entries("pre_bind"):
+            ready = []
+            for prof, state, pi, assumed, host in items:
+                if prof.plugins_relevant("pre_bind", assumed):
+                    if state is None:
+                        state = mk_state()
+                    status = prof.run_pre_bind_plugins(state, assumed, host)
+                else:
+                    status = None
+                if status is not None and not status.is_success():
+                    self._forget(assumed)
+                    prof.run_unreserve_plugins(state, assumed, host)
+                    self.record_scheduling_failure(
+                        prof, pi, status.message(), "SchedulerError", "",
+                        pod_scheduling_cycle,
+                    )
+                    continue
+                ready.append((prof, state, pi, assumed, host))
+            if not ready:
+                return
+        else:
+            ready = items
+        assumed_list = [t[3] for t in ready]
         bind_timer = metrics.SinceTimer(metrics.binding_duration)
         with timeline.span("bind_bulk"):
-            results = self.client.bind_bulk(bindings)
+            errors = self.client.bind_assumed_bulk(assumed_list)
         bind_timer.observe()
-        bound = []
-        for (prof, state, pi, assumed, host), (pod, err) in zip(ready, results):
-            if err is not None:
+        if errors:
+            failed = dict(errors)
+            bound = []
+            for i, item in enumerate(ready):
+                err = failed.get(i)
+                if err is None:
+                    bound.append(item)
+                    continue
+                prof, state, pi, assumed, host = item
                 metrics.schedule_attempts.inc(result="error")
                 self._forget(assumed)
-                prof.run_unreserve_plugins(state, assumed, host)
+                prof.run_unreserve_plugins(
+                    state if state is not None else mk_state(),
+                    assumed, host,
+                )
                 self.record_scheduling_failure(
                     prof, pi, str(err), "SchedulerError", "",
                     pod_scheduling_cycle,
                 )
-                continue
-            bound.append((prof, state, pi, assumed, host))
+            bound_assumed = [t[3] for t in bound]
+        else:
+            bound = ready
+            bound_assumed = assumed_list
         if not bound:
             return
         with timeline.span("finish_binding_bulk"):
-            self.cache.finish_binding_bulk([a for _, _, _, a, _ in bound])
-        prof0 = bound[0][0]
+            self.cache.finish_binding_bulk(bound_assumed)
         if prof0.has_plugins("post_bind"):
             for prof, state, pi, assumed, host in bound:
-                prof.run_post_bind_plugins(state, assumed, host)
+                prof.run_post_bind_plugins(
+                    state if state is not None else mk_state(),
+                    assumed, host,
+                )
         recorder = prof0.recorder
         with timeline.span("events+metrics"):
             self._emit_bound(recorder, bound)
@@ -1412,10 +1477,17 @@ class BatchScheduler(Scheduler):
     # -- loop ---------------------------------------------------------------
 
     def run(self) -> None:
+        from kubernetes_tpu.utils.gc_tuning import GCBatchGuard
+
         self.queue.run()
-        while not self._stop.is_set():
-            # in-flight batches land on the committer thread, so the
-            # dispatcher can always block for the next arrivals
-            self.schedule_batch(timeout=0.5, pipeline=True)
-        self._drain_pending()
-        self._stop_committer()
+        self._gc_guard = GCBatchGuard()
+        try:
+            while not self._stop.is_set():
+                # in-flight batches land on the committer thread, so the
+                # dispatcher can always block for the next arrivals
+                self.schedule_batch(timeout=0.5, pipeline=True)
+            self._drain_pending()
+            self._stop_committer()
+        finally:
+            guard, self._gc_guard = self._gc_guard, None
+            guard.close()

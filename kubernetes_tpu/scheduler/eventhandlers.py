@@ -63,78 +63,6 @@ def add_all_event_handlers(
             logger.exception("remove pod %s from cache", pod.key())
         sched.queue.move_all_to_active_or_backoff_queue(events.AssignedPodDelete)
 
-    def assigned_pods_batch(frame) -> None:
-        """Whole-frame bridge for assigned pods: the bind-echo burst
-        (thousands of MODIFIED events per frame during a 10k burst) is
-        confirmed into the cache under one lock and wakes affinity
-        matches with one move request; delete runs (preemption waves)
-        coalesce into one bulk cache remove + ONE queue move. Adds and
-        deletes never buffer simultaneously -- appending to either run
-        flushes the other first, and updates flush both -- so per-pod
-        event order within the frame is preserved (an add+delete pair
-        must not resurrect the pod by deferring its add past its
-        delete)."""
-        adds = []
-        deletes = []
-
-        def flush() -> None:
-            if adds:
-                try:
-                    sched.cache.add_pods(adds)
-                except Exception:
-                    logger.exception("bulk add pods to cache")
-                sched.queue.assigned_pods_added_many(adds)
-                adds.clear()
-            if deletes:
-                # one bulk cache remove + ONE queue move for the run (a
-                # preemption wave deletes hundreds of victims per frame;
-                # per-event this was a move_all PER victim)
-                try:
-                    sched.cache.remove_pods(deletes)
-                except Exception:
-                    logger.exception("bulk remove pods from cache")
-                sched.queue.move_all_to_active_or_backoff_queue(
-                    events.AssignedPodDelete
-                )
-                deletes.clear()
-
-        for etype, old, new in frame:
-            new_ok = _assigned(new)
-            old_ok = old is not None and _assigned(old)
-            if etype == "ADDED":
-                if new_ok:
-                    if deletes:
-                        flush()
-                    adds.append(new)
-            elif etype == "MODIFIED":
-                if old_ok and new_ok:
-                    flush()
-                    update_pod_in_cache(old, new)
-                elif not old_ok and new_ok:
-                    if deletes:
-                        flush()
-                    adds.append(new)
-                elif old_ok and not new_ok:
-                    if adds:
-                        flush()
-                    deletes.append(old)
-            elif etype == "DELETED":
-                if new_ok:
-                    if adds:
-                        flush()
-                    deletes.append(new)
-        flush()
-
-    pods.add_event_handler(
-        ResourceEventHandler(
-            filter_func=_assigned,
-            on_add=add_pod_to_cache,
-            on_update=update_pod_in_cache,
-            on_delete=delete_pod_from_cache,
-            on_batch=assigned_pods_batch,
-        )
-    )
-
     # unscheduled pods owned by one of our profiles -> queue (:381)
     def add_pod_to_queue(pod: Pod) -> None:
         sched.queue.add(pod)
@@ -163,73 +91,177 @@ def add_all_event_handlers(
         for fw in sched.profiles.values():
             fw.reject_waiting_pod(pod.metadata.uid)
 
-    def unassigned_pods_batch(frame) -> None:
-        """Whole-frame bridge for pending pods: CONSECUTIVE runs of
-        plain adds queue under one lock + one wakeup, consecutive runs of
-        queue-leaves (bound-pod echoes) leave in one bulk delete; every
-        other transition flushes both runs first so per-pod event order
-        within the frame is preserved. Gang-label adds keep the per-event
-        path (targeted sibling wakeups)."""
+    # -- the combined whole-frame bridge -------------------------------------
+    # ONE pass over each watch frame feeds BOTH sides (cache for assigned
+    # pods, queue for pending pods) -- the reference registers two
+    # filtered handlers (eventhandlers.go:356,:381); here the frame loop
+    # itself was the hot cost during a 10k burst (every event iterated
+    # twice, with the assigned-filter evaluated in both), so the two
+    # bridges share one loop. Run coalescing per side is preserved:
+    # consecutive cache adds confirm in one bulk add + one wakeup batch,
+    # cache deletes in one bulk remove + ONE queue move, queue adds/
+    # leaves in one bulk op; any opposing transition flushes that side
+    # first so per-pod event order within the frame holds. Cross-side
+    # order matches the old two-handler order (cache side flushed before
+    # queue side at every boundary and at frame end).
+
+    def combined_pod_update(old, new) -> None:
+        """Per-event fallback (non-batch dispatch): both sides' filter-
+        transition semantics (FilteringResourceEventHandler)."""
+        new_a = _assigned(new)
+        old_a = old is not None and _assigned(old)
+        if old_a and new_a:
+            update_pod_in_cache(old, new)
+        elif not old_a and new_a:
+            add_pod_to_cache(new)
+        elif old_a and not new_a:
+            delete_pod_from_cache(old)
+        new_q = not new_a and _responsible_for_pod(sched, new)
+        old_q = (
+            old is not None and not old_a and _responsible_for_pod(sched, old)
+        )
+        if old_q and new_q:
+            update_pod_in_queue(old, new)
+        elif not old_q and new_q:
+            add_pod_to_queue(new)
+        elif old_q and not new_q:
+            delete_pod_from_queue(old)
+
+    def combined_pod_add(pod) -> None:
+        if _assigned(pod):
+            add_pod_to_cache(pod)
+        elif _responsible_for_pod(sched, pod):
+            add_pod_to_queue(pod)
+
+    def combined_pod_delete(pod) -> None:
+        if _assigned(pod):
+            delete_pod_from_cache(pod)
+        elif _responsible_for_pod(sched, pod):
+            delete_pod_from_queue(pod)
+
+    def pods_batch(frame) -> None:
+        """One classification pass builds per-side ordered op-run lists;
+        execution then replays the WHOLE cache side before the queue side
+        -- exactly the old two-filtered-handler order (assigned handler
+        saw the full frame first), with consecutive same-kind ops merged
+        into bulk runs. A mixed create/bind-echo frame thus still commits
+        as one cache add_pods + a few queue add_many/delete_many calls,
+        and per-pod event order holds within each side because run order
+        follows event order."""
         from kubernetes_tpu.api.types import POD_GROUP_LABEL
 
-        adds = []
-        deletes = []
-
-        def flush() -> None:
-            if adds:
-                sched.queue.add_many(adds)
-                adds.clear()
-            if deletes:
-                sched.queue.delete_many(deletes)
-                # bound-pod echoes almost never have Permit waiters --
-                # skip the per-pod reject loop when no profile holds any
-                if any(fw.waiting_pods for fw in sched.profiles.values()):
-                    for pod in deletes:
-                        for fw in sched.profiles.values():
-                            fw.reject_waiting_pod(pod.metadata.uid)
-                deletes.clear()
+        profiles = sched.profiles
+        cache_runs = []  # ("adds"|"dels", [pods]) | ("update", (old,new))
+        queue_runs = []  # ("adds"|"dels", [pods]) | per-event kinds
 
         for etype, old, new in frame:
-            new_ok = not _assigned(new) and _responsible_for_pod(sched, new)
-            old_ok = (
-                old is not None
-                and not _assigned(old)
-                and _responsible_for_pod(sched, old)
-            )
-            if etype == "ADDED":
-                if new_ok:
-                    if new.metadata.labels.get(POD_GROUP_LABEL):
-                        flush()
-                        add_pod_to_queue(new)  # gang sibling wakeups
+            new_a = bool(new.spec.node_name)
+            if etype == "MODIFIED":
+                old_a = old is not None and bool(old.spec.node_name)
+                if new_a:
+                    if old_a:
+                        cache_runs.append(("update", (old, new)))
                     else:
-                        if deletes:
-                            flush()
-                        adds.append(new)
-            elif etype == "MODIFIED":
-                if old_ok and new_ok:
-                    flush()
-                    update_pod_in_queue(old, new)
-                elif not old_ok and new_ok:
-                    flush()
-                    add_pod_to_queue(new)
-                elif old_ok and not new_ok:
-                    if adds:
-                        flush()
-                    deletes.append(old)
+                        # bind echo: cache confirm + queue leave
+                        if cache_runs and cache_runs[-1][0] == "adds":
+                            cache_runs[-1][1].append(new)
+                        else:
+                            cache_runs.append(("adds", [new]))
+                        if old is not None and _responsible_for_pod(
+                            sched, old
+                        ):
+                            if queue_runs and queue_runs[-1][0] == "dels":
+                                queue_runs[-1][1].append(old)
+                            else:
+                                queue_runs.append(("dels", [old]))
+                elif old_a:
+                    if cache_runs and cache_runs[-1][0] == "dels":
+                        cache_runs[-1][1].append(old)
+                    else:
+                        cache_runs.append(("dels", [old]))
+                    if _responsible_for_pod(sched, new):
+                        queue_runs.append(("add_one", new))
+                else:
+                    old_q = old is not None and _responsible_for_pod(
+                        sched, old
+                    )
+                    new_q = _responsible_for_pod(sched, new)
+                    if old_q and new_q:
+                        queue_runs.append(("update", (old, new)))
+                    elif not old_q and new_q:
+                        queue_runs.append(("add_one", new))
+                    elif old_q:
+                        if queue_runs and queue_runs[-1][0] == "dels":
+                            queue_runs[-1][1].append(old)
+                        else:
+                            queue_runs.append(("dels", [old]))
+            elif etype == "ADDED":
+                if new_a:
+                    if cache_runs and cache_runs[-1][0] == "adds":
+                        cache_runs[-1][1].append(new)
+                    else:
+                        cache_runs.append(("adds", [new]))
+                elif _responsible_for_pod(sched, new):
+                    if new.metadata.labels.get(POD_GROUP_LABEL):
+                        # gang sibling wakeups take the per-event path
+                        queue_runs.append(("add_one", new))
+                    elif queue_runs and queue_runs[-1][0] == "adds":
+                        queue_runs[-1][1].append(new)
+                    else:
+                        queue_runs.append(("adds", [new]))
             elif etype == "DELETED":
-                if new_ok:
-                    flush()
-                    delete_pod_from_queue(new)
-        flush()
+                if new_a:
+                    if cache_runs and cache_runs[-1][0] == "dels":
+                        cache_runs[-1][1].append(new)
+                    else:
+                        cache_runs.append(("dels", [new]))
+                elif _responsible_for_pod(sched, new):
+                    queue_runs.append(("del_one", new))
+
+        # cache phase (whole frame), then queue phase
+        for kind, payload in cache_runs:
+            if kind == "adds":
+                try:
+                    sched.cache.add_pods(payload)
+                except Exception:
+                    logger.exception("bulk add pods to cache")
+                sched.queue.assigned_pods_added_many(payload)
+            elif kind == "dels":
+                # one bulk cache remove + ONE queue move per run (a
+                # preemption wave deletes hundreds of victims per frame)
+                try:
+                    sched.cache.remove_pods(payload)
+                except Exception:
+                    logger.exception("bulk remove pods from cache")
+                sched.queue.move_all_to_active_or_backoff_queue(
+                    events.AssignedPodDelete
+                )
+            else:
+                update_pod_in_cache(*payload)
+        for kind, payload in queue_runs:
+            if kind == "adds":
+                sched.queue.add_many(payload)
+            elif kind == "dels":
+                sched.queue.delete_many(payload)
+                # bound-pod echoes almost never have Permit waiters --
+                # skip the per-pod reject loop when no profile holds any
+                if any(fw.waiting_pods for fw in profiles.values()):
+                    for pod in payload:
+                        for fw in profiles.values():
+                            fw.reject_waiting_pod(pod.metadata.uid)
+            elif kind == "add_one":
+                add_pod_to_queue(payload)
+            elif kind == "update":
+                update_pod_in_queue(*payload)
+            else:
+                delete_pod_from_queue(payload)
 
     pods.add_event_handler(
         ResourceEventHandler(
-            filter_func=lambda p: not _assigned(p)
-            and _responsible_for_pod(sched, p),
-            on_add=add_pod_to_queue,
-            on_update=update_pod_in_queue,
-            on_delete=delete_pod_from_queue,
-            on_batch=unassigned_pods_batch,
+            on_add=combined_pod_add,
+            on_update=combined_pod_update,
+            on_delete=combined_pod_delete,
+            on_batch=pods_batch,
         )
     )
 

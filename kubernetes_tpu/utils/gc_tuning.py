@@ -13,6 +13,7 @@ mitigation for CPython services.
 from __future__ import annotations
 
 import gc
+import time as _time
 
 
 def freeze_steady_state_graph(
@@ -23,3 +24,50 @@ def freeze_steady_state_graph(
     gc.collect()
     gc.freeze()
     gc.set_threshold(gen0, gen1, gen2)
+
+
+class GCBatchGuard:
+    """Collect-at-idle policy for the batch dispatcher.
+
+    Even with the steady-state graph frozen and thresholds stretched, a
+    10k-pod burst allocates enough (clones, watch events, queue entries,
+    solver bookkeeping) to trigger several young-generation collections
+    INSIDE the measured window; each scans the whole unfrozen young set
+    (measured ~7us/pod of the commit path -- 4x the actual object work).
+    The scheduler knows its own idle points (queue drained, nothing in
+    flight), so cyclic collection is disabled while batches are being
+    scheduled and runs once at the active->idle transition. Plain
+    refcounting still reclaims the (acyclic) burst garbage immediately;
+    the deferred pass only exists to catch stray cycles (tracebacks,
+    closures)."""
+
+    #: under SUSTAINED load (the queue never drains) a bounded young-
+    #: generation collect runs at most this often, so stray cycles from a
+    #: long active phase cannot grow RSS without bound
+    ACTIVE_COLLECT_INTERVAL_S = 10.0
+
+    def __init__(self) -> None:
+        self._active = False
+        self._last_collect = 0.0
+
+    def active(self) -> None:
+        if not self._active:
+            gc.disable()
+            self._active = True
+            self._last_collect = _time.monotonic()
+            return
+        now = _time.monotonic()
+        if now - self._last_collect >= self.ACTIVE_COLLECT_INTERVAL_S:
+            # explicit collect works while the collector is disabled;
+            # gen-1 keeps the pause bounded (young objects only)
+            gc.collect(1)
+            self._last_collect = now
+
+    def idle(self) -> None:
+        if self._active:
+            gc.enable()
+            gc.collect()
+            self._active = False
+
+    def close(self) -> None:
+        self.idle()

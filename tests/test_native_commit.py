@@ -1,0 +1,184 @@
+"""Differential parity: the native commit spine vs the Python fallback.
+
+native/_hotpath.c assume_clones + bind_assumed_bulk are the C forms of
+Pod.assumed_clone (api/types.py) and the bind_bulk transaction loop
+(apiserver/server.py _bind_locked); these tests run the same inputs
+through both implementations and require identical outcomes (slots,
+error types, store state, watch events, sharing structure).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubernetes_tpu.api.types import Binding
+from kubernetes_tpu.apiserver import server as server_mod
+from kubernetes_tpu.apiserver.server import APIServer, Conflict, NotFound
+from kubernetes_tpu.testing import make_pod
+
+native = pytest.importorskip("kubernetes_tpu.native")
+if native.hotpath is None:  # pragma: no cover - build failure environment
+    pytest.skip("native module unavailable", allow_module_level=True)
+
+
+def _mk_pods(n, prefix="p"):
+    return [
+        make_pod(f"{prefix}-{i}").container(cpu="100m", memory="128Mi").obj()
+        for i in range(n)
+    ]
+
+
+# -- assume_clones vs Pod.assumed_clone ------------------------------------
+
+
+def test_assume_clones_matches_assumed_clone_structure():
+    pods = _mk_pods(4)
+    hosts = [f"node-{i}" for i in range(4)]
+    clones = native.assume_clones(pods, hosts)
+    for pod, host, clone in zip(pods, hosts, clones):
+        ref = pod.assumed_clone()
+        ref.spec.node_name = host
+        # same mutation result
+        assert clone.spec.node_name == host
+        assert pod.spec.node_name == ""  # original untouched
+        # same sharing structure: fresh pod + fresh spec, shared rest
+        assert clone is not pod and clone.spec is not pod.spec
+        assert clone.metadata is pod.metadata
+        assert clone.status is pod.status
+        assert clone.spec.containers is pod.spec.containers
+        assert ref.metadata is pod.metadata  # fallback agrees
+        assert clone.kind == "Pod"
+
+
+def test_assume_clones_inherits_memos():
+    from kubernetes_tpu.cache.node_info import pod_hot_info
+
+    pods = _mk_pods(2, "m")
+    memo = pod_hot_info(pods[0])
+    clones = native.assume_clones(pods, ["n1", "n2"])
+    assert clones[0].__dict__.get("_hot_memo") == memo
+    assert "_hot_memo" not in clones[1].__dict__
+
+
+# -- bind_assumed_bulk: native vs fallback ---------------------------------
+
+
+def _run_bind_scenario(use_native):
+    """One mixed scenario through either implementation; returns
+    (errors, store_pods, events) for comparison."""
+    server = APIServer()
+    pods = _mk_pods(6, "b")
+    server.create_bulk(pods)
+    # slot 2: already bound to another node; slot 5: bound to the SAME
+    # node (idempotent re-bind succeeds, _bind_locked semantics)
+    server.bind(
+        Binding(
+            pod_namespace="default", pod_name="b-2",
+            pod_uid=pods[2].metadata.uid, target_node="elsewhere",
+        )
+    )
+    server.bind(
+        Binding(
+            pod_namespace="default", pod_name="b-5",
+            pod_uid=pods[5].metadata.uid, target_node="node-5",
+        )
+    )
+    watch = server.watch("Pod", since_rv=server.current_rv())
+
+    assumed = native.assume_clones(
+        [server.get("Pod", "default", f"b-{i}") for i in range(6)],
+        [f"node-{i}" for i in range(6)],
+    )
+    # slot 1: uid mismatch; slot 3: missing pod; slot 4: empty target
+    assumed[1].metadata = pods[1].metadata.__class__(
+        name="b-1", namespace="default", uid="wrong-uid"
+    )
+    gone = make_pod("gone").container(cpu="1m", memory="1Mi").obj()
+    assumed[3] = native.assume_clones([gone], ["node-3"])[0]
+    assumed[4].spec.node_name = ""
+
+    if use_native:
+        errors = server.bind_assumed_bulk(assumed)
+    else:
+        orig = server_mod._bind_assumed_bulk
+        server_mod._bind_assumed_bulk = None
+        try:
+            errors = server.bind_assumed_bulk(assumed)
+        finally:
+            server_mod._bind_assumed_bulk = orig
+    store = {
+        name: server.get("Pod", "default", name).spec.node_name
+        for name in [f"b-{i}" for i in range(6)]
+    }
+    events = watch.pending()
+    return errors, store, events
+
+
+def test_bind_assumed_bulk_native_matches_fallback():
+    n_err, n_store, n_events = _run_bind_scenario(use_native=True)
+    f_err, f_store, f_events = _run_bind_scenario(use_native=False)
+
+    # slot 1 uid mismatch, slot 2 rebind-to-other-node, slot 3 missing,
+    # slot 4 empty target; slots 0 and 5 bind
+    assert [i for i, _ in n_err] == [i for i, _ in f_err] == [1, 2, 3, 4]
+    for (ni, ne), (fi, fe) in zip(n_err, f_err):
+        assert type(ne) is type(fe), (ne, fe)
+    assert isinstance(n_err[0][1], Conflict)
+    assert isinstance(n_err[1][1], Conflict)
+    assert isinstance(n_err[2][1], NotFound)
+    assert isinstance(n_err[3][1], ValueError)
+
+    assert n_store == f_store
+    assert n_store["b-0"] == "node-0"
+    assert n_store["b-2"] == "elsewhere"  # conflict slot untouched
+    assert n_store["b-4"] == ""  # empty-target slot untouched
+    assert n_store["b-5"] == "node-5"  # idempotent re-bind
+
+    # same event stream shape: MODIFIED for each success, rv ascending
+    assert len(n_events) == len(f_events) == 2
+    assert all(ev.type == "MODIFIED" for ev in n_events)
+    rvs = [ev.resource_version for ev in n_events]
+    assert rvs == sorted(rvs)
+    assert [ev.object.metadata.name for ev in n_events] == [
+        ev.object.metadata.name for ev in f_events
+    ]
+
+
+def test_bind_assumed_bulk_cow_and_memo_semantics():
+    server = APIServer()
+    pods = _mk_pods(2, "c")
+    server.create_bulk(pods)
+    stored_before = server.get("Pod", "default", "c-0")
+    stored_before.__dict__["_sig_memo"] = ("stale",)
+    assumed = native.assume_clones(pods, ["n-0", "n-1"])
+    assert server.bind_assumed_bulk(assumed) == []
+    stored_after = server.get("Pod", "default", "c-0")
+    # fresh pod object with fresh metadata (new rv) + fresh spec
+    assert stored_after is not stored_before
+    assert stored_after.metadata is not stored_before.metadata
+    assert stored_after.spec is not stored_before.spec
+    assert (
+        stored_after.metadata.resource_version
+        > stored_before.metadata.resource_version
+    )
+    # status may be shared (read-only contract); the sig memo computed
+    # against the unbound spec must not ride along
+    assert "_sig_memo" not in stored_after.__dict__
+    # the old stored object is untouched (informer (old, new) contract)
+    assert stored_before.spec.node_name == ""
+
+
+def test_bind_assumed_bulk_rv_matches_store_counter():
+    server = APIServer()
+    pods = _mk_pods(3, "r")
+    server.create_bulk(pods)
+    assumed = native.assume_clones(pods, ["x", "y", "z"])
+    assert server.bind_assumed_bulk(assumed) == []
+    assert (
+        server.get("Pod", "default", "r-2").metadata.resource_version
+        == server.current_rv()
+    )
+    # a follow-up write continues the monotonic sequence
+    more = _mk_pods(1, "rr")
+    server.create_bulk(more)
+    assert more[0].metadata.resource_version == server.current_rv()
