@@ -88,6 +88,26 @@ class BindCollector:
                 self._cond.wait(min(remaining, 0.5))
             return True
 
+    def wait_fraction(self, fraction: float, timeout: float) -> bool:
+        """Wait until ``fraction`` of the targets have bound AND the
+        bind rate has gone quiet (no new binds for one settle window) --
+        the completion criterion for capacity-starved workloads where
+        full placement is impossible by design."""
+        need = int(fraction * len(self._targets))
+        deadline = time.time() + timeout
+        last_count = -1
+        quiet_since = time.time()
+        while time.time() < deadline:
+            with self._cond:
+                count = len(self._targets) - self._outstanding
+            if count != last_count:
+                last_count = count
+                quiet_since = time.time()
+            elif count >= need and time.time() - quiet_since >= 2.0:
+                return True
+            time.sleep(0.05)
+        return last_count >= need
+
     def stop(self) -> None:
         self._stop = True
         self._watch.stop()
@@ -138,6 +158,18 @@ def _build_pod(name: str, spec: Dict[str, Any], idx: int):
                 match_labels=af.get("match_labels") or {},
                 anti=bool(af.get("anti")),
             )
+    if spec.get("node_selector"):
+        w.node_selector(**spec["node_selector"])
+    naff = spec.get("node_affinity_in")
+    if naff:
+        # required node affinity; values may rotate per pod index so a
+        # 5k-node matrix entry exercises per-pod static-mask variety
+        values = naff.get("values") or []
+        if naff.get("rotate") and values:
+            values = [values[idx % len(values)]]
+        w.node_affinity_in(naff["key"], list(values))
+    for s in range(int(spec.get("secret_volumes", 0))):
+        w.secret_volume(f"secret-{idx % 16}-{s}")
     return w.obj()
 
 
@@ -183,6 +215,24 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
                 selector=dict(svc.get("selector") or {}),
             )
         )
+
+    # SchedulingSecrets (reference performance-config.yaml): pods mount
+    # secret volumes; the pool matches _build_pod's secret-{idx%16}-{s}
+    # naming so every reference resolves to a stored Secret
+    n_sec = int((wl.get("pod") or {}).get("secret_volumes", 0) or 0)
+    if n_sec:
+        from kubernetes_tpu.api.types import Secret
+
+        for i in range(16):
+            for s in range(n_sec):
+                server.create(
+                    Secret(
+                        metadata=ObjectMeta(
+                            name=f"secret-{i}-{s}", namespace="default"
+                        ),
+                        data={"token": f"t-{i}-{s}"},
+                    )
+                )
 
     gang = wl.get("gang")
     measure_pods = int(wl["measure_pods"])
@@ -252,7 +302,11 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         coll = BindCollector(server, target_names)
         create_times: Dict[str, float] = {}
 
+        from kubernetes_tpu.utils import timeline as _timeline
+
+        _timeline.reset()
         start = time.perf_counter()
+        _timeline.mark("burst_start")
         ok = True
         if churn:
             # BASELINE #5: steady-state churn -- delete a slice of running
@@ -288,14 +342,29 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
             for p in pods:
                 create_times[p.metadata.name] = time.perf_counter()
                 client.create_pod(p)
-            ok = coll.wait(timeout_s)
+            frac = float(wl.get("min_bound_fraction", 1.0))
+            if frac < 1.0:
+                ok = coll.wait_fraction(frac, timeout_s)
+            else:
+                ok = coll.wait(timeout_s)
         elapsed = time.perf_counter() - start
+        if float(wl.get("min_bound_fraction", 1.0)) < 1.0 and coll.bind_times:
+            # wait_fraction needs a 2s quiet window to decide the system
+            # settled; the measured window ends at the LAST BIND, not at
+            # the detector's return
+            elapsed = max(coll.bind_times.values()) - start
+        if _timeline.ENABLED:
+            print(_timeline.dump(start), file=sys.stderr, flush=True)
         sched.wait_for_inflight_binds(timeout=60)
 
         bound = sum(1 for n in target_names if n in coll.bind_times)
+        # capacity-starved workloads (GangContention) EXPECT a fraction
+        # of pods to stay pending; they pass on reaching the fraction
+        # with clean bookkeeping instead of full placement
+        min_frac = float(wl.get("min_bound_fraction", 1.0))
         result: Dict[str, Any] = {
             "name": name,
-            "ok": bool(ok and bound == len(target_names)),
+            "ok": bool(ok and bound >= min_frac * len(target_names)),
             "bound": bound,
             "total": len(target_names),
             "elapsed_s": round(elapsed, 3),
@@ -363,6 +432,7 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
             "pipeline_drains": sched.pipeline_drains,
             "state_reuses": sched.state_reuses,
             "state_uploads": sched.state_uploads,
+            "gang_resolves": sched.gang_resolves,
         }
         return result
     finally:

@@ -293,6 +293,7 @@ def pod_from_dict(raw: Dict[str, Any]) -> Pod:
             aws_ebs_volume_id=(
                 (v.get("awsElasticBlockStore") or {}).get("volumeID", "")
             ),
+            secret_name=(v.get("secret") or {}).get("secretName", ""),
         )
         for v in spec.get("volumes") or []
     ]
@@ -540,6 +541,11 @@ def pod_to_dict(pod: Pod) -> Dict[str, Any]:
                         }
                     }
                     if v.aws_ebs_volume_id
+                    else {}
+                ),
+                **(
+                    {"secret": {"secretName": v.secret_name}}
+                    if v.secret_name
                     else {}
                 ),
             }

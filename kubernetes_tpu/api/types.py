@@ -249,6 +249,9 @@ class Volume:
     aws_ebs_volume_id: str = ""
     iscsi_target: str = ""  # iqn+lun identity
     rbd_image: str = ""  # pool+image identity
+    secret_name: str = ""  # secret.secretName (no filter reads it; the
+    # SchedulingSecrets perf workload measures the object-graph weight,
+    # reference scheduler_perf performance-config.yaml)
     read_only: bool = False
 
 
@@ -497,6 +500,23 @@ class Service:
     selector: Dict[str, str] = field(default_factory=dict)
 
     kind: str = "Service"
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+@dataclass
+class Secret:
+    """Opaque key/value secret (reference core/v1 Secret). The scheduler
+    never reads one; pods referencing secret volumes ride the pipeline
+    with the extra object weight the SchedulingSecrets perf workload
+    measures (test/integration/scheduler_perf/config/
+    performance-config.yaml)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+
+    kind: str = "Secret"
 
     def key(self) -> str:
         return f"{self.metadata.namespace}/{self.metadata.name}"

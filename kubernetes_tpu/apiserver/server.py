@@ -144,6 +144,7 @@ class APIServer:
         "Pod", "Node", "PodDisruptionBudget", "PodGroup", "Lease", "Service",
         "PersistentVolume", "PersistentVolumeClaim", "StorageClass",
         "CSINode", "ReplicationController", "ReplicaSet", "StatefulSet",
+        "Secret",
     )
 
     def __init__(self, watch_history_limit: int = 200_000) -> None:

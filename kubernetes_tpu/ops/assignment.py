@@ -338,6 +338,13 @@ def greedy_assign_compact(
     )
 
 
+#: family tuple sizes for the packed constrained layout (the order
+#: matches greedy_assign_constrained's spread/affinity/scoring tuples)
+_N_SPREAD = 7
+_N_AFFINITY = 14
+_N_SCORING = 20
+
+
 @partial(
     jax.jit, static_argnames=("layout", "config", "mode", "use_pallas")
 )
@@ -347,7 +354,7 @@ def _solve_packed_jit(
     valid_in,  # [N] bool device-resident, or None when in buf
     req_in,  # [N, R] int32 carried device state, or None when in buf
     nzr_in,  # [N, 2] int32 carried device state, or None when in buf
-    layout: Tuple,  # static ((name, shape), ...) describing buf slices
+    layout: Tuple,  # static ((name, shape, kind), ...) describing buf slices
     config: GreedyConfig = GreedyConfig(),
     mode: str = "greedy",
     use_pallas: bool = False,
@@ -356,19 +363,27 @@ def _solve_packed_jit(
 
     Over the serving link every device_put operand pays its own
     round-trip (measured ~40-90ms each on the tunneled chip, ~340ms for
-    the batch's 5-9 arrays); concatenating the per-batch upload into one
-    int32 buffer makes it one transfer and this wrapper re-slices it
-    on device (static offsets, free after fusion). Returns
-    (assignment, requested', nzr', allocatable, valid) -- the last two
-    so the caller can keep device-resident refs when they rode the
-    buffer."""
+    the batch's 5-9 arrays -- and >1s for a constrained batch's ~40
+    family tensors when host Python contends for the link); concatenating
+    the per-batch upload into one int32 buffer makes it one transfer and
+    this wrapper re-slices it on device (static offsets, free after
+    fusion). ``kind`` restores dtypes: 'i' int32, 'b' bool, 'f' float32
+    (bitcast -- float tensors ride the int32 buffer bit-exactly).
+    Returns (assignment, requested', nzr', allocatable, valid) -- the
+    last two so the caller can keep device-resident refs when they rode
+    the buffer."""
     arrs = {}
     off = 0
-    for name, shape in layout:
+    for name, shape, kind in layout:
         size = 1
         for d in shape:
             size *= d
-        arrs[name] = buf[off:off + size].reshape(shape)
+        a = buf[off:off + size].reshape(shape)
+        if kind == "b":
+            a = a.astype(bool)
+        elif kind == "f":
+            a = jax.lax.bitcast_convert_type(a, jnp.float32)
+        arrs[name] = a
         off += size
     alloc = arrs["alloc"] if "alloc" in arrs else alloc_in
     valid = arrs["valid"].astype(bool) if "valid" in arrs else valid_in
@@ -379,6 +394,25 @@ def _solve_packed_jit(
     midx = arrs["midx"]
     active = arrs["active"].astype(bool)
     rows = arrs["rows"].astype(bool)
+    if mode == "constrained":
+        spread = tuple(arrs[f"sp{i}"] for i in range(_N_SPREAD))
+        affinity = tuple(arrs[f"af{i}"] for i in range(_N_AFFINITY))
+        scoring = tuple(arrs[f"sc{i}"] for i in range(_N_SCORING))
+        if use_pallas:
+            # fused constrained kernel (ops/pallas_constrained.py):
+            # ~4.2x the XLA constrained scan per solve on the chip
+            from kubernetes_tpu.ops.pallas_constrained import (
+                pallas_constrained_solve,
+            )
+
+            c_solver = pallas_constrained_solve
+        else:
+            c_solver = greedy_assign_constrained
+        assignment, req_out, nzr_out = c_solver(
+            alloc, req_state, nzr_state, valid, pod_req, pod_nzr_, rows,
+            midx, active, spread, affinity, scoring, config=config,
+        )
+        return assignment, req_out, nzr_out, alloc, valid
     if mode == "sinkhorn":
         solver = sinkhorn_assign
     elif use_pallas:
@@ -396,8 +430,18 @@ def _solve_packed_jit(
     return assignment, req_out, nzr_out, alloc, valid
 
 
+def _piece_kind(arr) -> str:
+    import numpy as _np
+
+    if arr.dtype == _np.float32:
+        return "f"
+    if arr.dtype == _np.bool_:
+        return "b"
+    return "i"
+
+
 def solve_packed(
-    pieces,  # ordered [(name, np.int32 ndarray)] to ride the buffer
+    pieces,  # ordered [(name, ndarray)] to ride the buffer
     alloc_in,
     valid_in,
     req_in,
@@ -406,21 +450,39 @@ def solve_packed(
     mode: str = "greedy",
 ):
     """Host-side companion of _solve_packed_jit: concatenates the pieces
-    (all int32, bools pre-cast by the caller) and dispatches one upload +
-    one solve. The greedy mode runs the fused Pallas kernel on TPU
-    backends (KTPU_PALLAS=0 opts out; batch shapes the kernel's SMEM
-    chunking can't tile fall back to the XLA scan)."""
+    (int32 / bool / float32 -- see _solve_packed_jit's kind codes) and
+    dispatches one upload + one solve. The greedy mode runs the fused
+    Pallas kernel on TPU backends (KTPU_PALLAS=0 opts out; batch shapes
+    the kernel's SMEM chunking can't tile fall back to the XLA scan)."""
     import numpy as _np
 
-    layout = tuple((name, arr.shape) for name, arr in pieces)
-    b = dict(layout)["req"][0]
+    layout = tuple(
+        (name, arr.shape, _piece_kind(arr)) for name, arr in pieces
+    )
+    b = next(s for n, s, _ in layout if n == "req")[0]
+    if alloc_in is not None:
+        n_cap = alloc_in.shape[0]
+    else:
+        n_cap = next(s for n, s, _ in layout if n == "alloc")[0]
     use_pallas = (
-        mode == "greedy"
+        mode in ("greedy", "constrained")
         and _os.environ.get("KTPU_PALLAS", "1") != "0"
         and jax.default_backend() == "tpu"
         and (b <= 1024 or b % 1024 == 0)
+        # the constrained kernel keeps ~500 [rows, N] count/value
+        # matrices VMEM-resident (~2KB/node); past ~5.6k nodes it
+        # exceeds the ~16MB VMEM budget and the XLA scan takes over
+        and (mode != "constrained" or n_cap <= 5632)
     )
-    buf = _np.concatenate([arr.ravel() for _, arr in pieces])
+
+    def as_i32(arr):
+        if arr.dtype == _np.float32:
+            return _np.ascontiguousarray(arr).view(_np.int32)
+        if arr.dtype == _np.int32:
+            return arr
+        return arr.astype(_np.int32)
+
+    buf = _np.concatenate([as_i32(arr).ravel() for _, arr in pieces])
     buf_d = jax.device_put(buf)
     return _solve_packed_jit(
         buf_d, alloc_in, valid_in, req_in, nzr_in,

@@ -207,6 +207,7 @@ class BatchScheduler(Scheduler):
         # envelope fallbacks were unmetered)
         self.envelope_fallbacks = 0  # whole batches sent to host by packers
         self.pipeline_drains = 0  # constrained dispatch drained the pipeline
+        self.gang_resolves = 0  # quorum-failure re-solves (_gang_fixup)
         self.nominee_constrained_fallbacks = 0  # nominees + constraints
         self.state_reuses = 0
         self.state_uploads = 0
@@ -336,6 +337,7 @@ class BatchScheduler(Scheduler):
                 pending["gang_failed_uids"] = inactive
                 return pending
             inactive |= failed
+            self.gang_resolves += 1
             with self._shadow_lock:
                 self._dev.invalidate_carry()
             pending = self._dispatch_solve(
@@ -780,15 +782,18 @@ class BatchScheduler(Scheduler):
             self._drain_pending()
             return self._dispatch_solve(solver_infos, pod_scheduling_cycle)
 
-        if (
-            self.mesh is None
-            and spread is None
-            and affinity is None
-            and score_batch is None
-        ):
+        constrained = (
+            spread is not None
+            or affinity is not None
+            or score_batch is not None
+        )
+        if self.mesh is None:
             # single-buffer upload: over the serving link every device_put
             # operand pays its own round trip (~40-90ms each); the whole
-            # batch rides ONE int32 buffer, re-sliced on device
+            # batch -- including a constrained batch's ~40 family count
+            # tensors, which used to pay ~1s of per-leaf link round trips
+            # under host CPU contention -- rides ONE int32 buffer,
+            # re-sliced (and bitcast for float tensors) on device
             # (ops/assignment.py solve_packed)
             pieces = [
                 ("req", req),
@@ -811,6 +816,28 @@ class BatchScheduler(Scheduler):
                 self.state_uploads += 1
             else:
                 self.state_reuses += 1
+            if constrained:
+                sp_arrs = (
+                    pad_spread_tensors(spread, padded)
+                    if spread is not None
+                    else noop_spread_tensors(padded, nt.capacity)
+                )
+                af_arrs = (
+                    pad_affinity_tensors(affinity, padded)
+                    if affinity is not None
+                    else noop_affinity_tensors(padded, nt.capacity)
+                )
+                sc_arrs = (
+                    pad_score_tensors(score_batch, padded)
+                    if score_batch is not None
+                    else noop_score_tensors(padded, nt.capacity)
+                )
+                for i, a in enumerate(sp_arrs):
+                    pieces.append((f"sp{i}", np.asarray(a)))
+                for i, a in enumerate(af_arrs):
+                    pieces.append((f"af{i}", np.asarray(a)))
+                for i, a in enumerate(sc_arrs):
+                    pieces.append((f"sc{i}", np.asarray(a)))
             # pass None for pieces riding the buffer so the jit sees one
             # stable signature per layout (a stale device ref would fork
             # a needless compile variant)
@@ -823,7 +850,9 @@ class BatchScheduler(Scheduler):
                     ds.valid_dev if static_ok else None,
                     ds.req_dev if carry_ok else None,
                     ds.nzr_dev if carry_ok else None,
-                    config=self.solver_config, mode=self.solver_mode,
+                    config=self.solver_config,
+                    mode="constrained" if constrained
+                    else self.solver_mode,
                 )
             if not static_ok:
                 ds.alloc_dev, ds.valid_dev = alloc_out, valid_out
@@ -1466,13 +1495,37 @@ class BatchScheduler(Scheduler):
         )
         if self.mesh is not None:
             sp_dev, af_dev, sc_dev = jax.device_put(noops, self._sh_repl)
+            out = greedy_assign_constrained(
+                *common, tuple(sp_dev), tuple(af_dev), tuple(sc_dev),
+                config=self.solver_config,
+            )
+            jax.block_until_ready(out)
         else:
-            sp_dev, af_dev, sc_dev = jax.device_put(noops)
-        out = greedy_assign_constrained(
-            *common, tuple(sp_dev), tuple(af_dev), tuple(sc_dev),
-            config=self.solver_config,
-        )
-        jax.block_until_ready(out)
+            # compile the packed constrained layouts the run loop can hit
+            # (cold / carry-refresh / steady), mirroring the basic-path
+            # variants above -- a first constrained batch must not pay a
+            # multi-second XLA compile inside the measured window
+            fam = (
+                [(f"sp{i}", np.asarray(a)) for i, a in enumerate(noops[0])]
+                + [(f"af{i}", np.asarray(a)) for i, a in enumerate(noops[1])]
+                + [(f"sc{i}", np.asarray(a)) for i, a in enumerate(noops[2])]
+            )
+            c_cold = solve_packed(
+                base + static_pieces + carry_pieces + fam,
+                None, None, None, None,
+                config=self.solver_config, mode="constrained",
+            )
+            jax.block_until_ready(c_cold)
+            c_refresh = solve_packed(
+                base + carry_pieces + fam, alloc_d, valid_d, None, None,
+                config=self.solver_config, mode="constrained",
+            )
+            jax.block_until_ready(c_refresh)
+            c_steady = solve_packed(
+                base + fam, alloc_d, valid_d, req_d, nzr_d,
+                config=self.solver_config, mode="constrained",
+            )
+            jax.block_until_ready(c_steady)
 
     # -- loop ---------------------------------------------------------------
 

@@ -217,6 +217,12 @@ class PodWrapper:
         self.pod.spec.volumes.append(Volume(name=claim_name, pvc_claim_name=claim_name))
         return self
 
+    def secret_volume(self, secret_name: str) -> "PodWrapper":
+        self.pod.spec.volumes.append(
+            Volume(name=secret_name, secret_name=secret_name)
+        )
+        return self
+
     def gce_pd(self, pd_name: str, read_only: bool = False) -> "PodWrapper":
         self.pod.spec.volumes.append(
             Volume(name=pd_name, gce_pd_name=pd_name, read_only=read_only)

@@ -1,0 +1,219 @@
+"""Constrained Pallas kernel (ops/pallas_constrained.py) vs the XLA
+constrained scan (ops/assignment.greedy_assign_constrained): randomized
+differential parity in interpreter mode, over batches packed by the real
+family packers exactly the way the BatchScheduler packs them."""
+
+import math
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.cache.snapshot import new_snapshot
+from kubernetes_tpu.ops.affinity import (
+    noop_affinity_tensors,
+    pack_affinity_batch,
+    pad_affinity_tensors,
+)
+from kubernetes_tpu.ops.assignment import (
+    GreedyConfig,
+    greedy_assign_constrained,
+)
+from kubernetes_tpu.ops.host_masks import static_mask_compact
+from kubernetes_tpu.ops.pallas_constrained import pallas_constrained_solve
+from kubernetes_tpu.ops.scoring import (
+    noop_score_tensors,
+    pack_score_batch,
+    pad_score_tensors,
+)
+from kubernetes_tpu.ops.topology import (
+    noop_spread_tensors,
+    pack_spread_batch,
+    pad_spread_tensors,
+)
+from kubernetes_tpu.tensors import NodeTensorCache, pack_pod_batch
+from kubernetes_tpu.testing import make_node, make_pod
+
+MASK_ROW_BUCKET = 8
+POD_BUCKET = 64
+
+DEFAULT_WEIGHTS = {
+    "NodeAffinity": 1,
+    "TaintToleration": 1,
+    "DefaultPodTopologySpread": 1,
+    "PodTopologySpread": 2,
+    "InterPodAffinity": 1,
+}
+
+
+def _cluster(rng, n_nodes=24):
+    nodes = []
+    for i in range(n_nodes):
+        nd = (
+            make_node(f"node-{i}")
+            .capacity(cpu="16", memory="32Gi", pods=32)
+            .label("topology.kubernetes.io/zone", f"zone-{i % 3}")
+            .label("rack", f"rack-{i % 5}")
+            .label("kubernetes.io/hostname", f"node-{i}")
+        )
+        nodes.append(nd.obj())
+    apps = ["a", "b", "c"]
+    existing = []
+    for i in range(rng.randrange(10, 30)):
+        p = (
+            make_pod(f"ex-{i}")
+            .node(f"node-{rng.randrange(n_nodes)}")
+            .container(cpu="200m", memory="256Mi")
+            .labels(app=rng.choice(apps))
+        )
+        roll = rng.random()
+        if roll < 0.25:
+            p = p.pod_affinity(
+                "topology.kubernetes.io/zone",
+                {"app": rng.choice(apps)},
+                anti=True,
+            )
+        elif roll < 0.4:
+            p = p.preferred_pod_affinity(
+                "rack",
+                {"app": rng.choice(apps)},
+                weight=rng.randrange(1, 20),
+                anti=rng.random() < 0.5,
+            )
+        existing.append(p.obj())
+    return existing, nodes
+
+
+def _batch(rng, b=24):
+    apps = ["a", "b", "c"]
+    out = []
+    for i in range(b):
+        p = (
+            make_pod(f"pod-{i}")
+            .container(cpu="300m", memory="384Mi")
+            .labels(app=rng.choice(apps))
+        )
+        roll = rng.random()
+        if roll < 0.2:
+            p = p.pod_affinity(
+                "kubernetes.io/hostname",
+                {"app": rng.choice(apps)},
+                anti=True,
+            )
+        elif roll < 0.35:
+            p = p.pod_affinity(
+                "topology.kubernetes.io/zone", {"app": rng.choice(apps)}
+            )
+        elif roll < 0.5:
+            p = p.spread_constraint(
+                max_skew=rng.randrange(1, 4),
+                topology_key="topology.kubernetes.io/zone",
+                when_unsatisfiable="DoNotSchedule",
+                match_labels={"app": p.obj().metadata.labels["app"]},
+            )
+        elif roll < 0.65:
+            p = p.preferred_pod_affinity(
+                "topology.kubernetes.io/zone",
+                {"app": rng.choice(apps)},
+                weight=rng.randrange(1, 30),
+                anti=rng.random() < 0.4,
+            )
+        out.append(p.obj())
+    return out
+
+
+def _packed_problem(seed):
+    """Mirror batch.py _dispatch_solve's packing for a constrained batch
+    (no nominees, no gangs)."""
+    rng = random.Random(seed)
+    existing, nodes = _cluster(rng)
+    snap = new_snapshot(existing, nodes)
+    nt = NodeTensorCache().update(snap)
+    pods = _batch(rng)
+
+    batch = pack_pod_batch(pods, nt.dims)
+    mask_rows, mask_index = static_mask_compact(pods, snap, nt)
+    if batch.unsatisfiable.any():
+        mask_rows = np.concatenate(
+            [mask_rows, np.zeros((1, nt.capacity), dtype=bool)]
+        )
+        mask_index = mask_index.copy()
+        mask_index[batch.unsatisfiable] = mask_rows.shape[0] - 1
+
+    b = batch.size
+    padded = POD_BUCKET * math.ceil(b / POD_BUCKET)
+    order = batch.order
+    req = np.zeros((padded, nt.dims.num_dims), dtype=np.int32)
+    nzr = np.zeros((padded, 2), dtype=np.int32)
+    midx = np.zeros(padded, dtype=np.int32)
+    active = np.zeros(padded, dtype=bool)
+    req[:b] = batch.requests[order]
+    nzr[:b] = batch.non_zero_requests[order]
+    midx[:b] = mask_index[order]
+    active[:b] = True
+    u = mask_rows.shape[0]
+    u_padded = MASK_ROW_BUCKET * math.ceil(u / MASK_ROW_BUCKET)
+    rows = np.zeros((u_padded, nt.capacity), dtype=bool)
+    rows[:u] = mask_rows
+
+    ordered = [pods[int(i)] for i in order]
+    sp = pack_spread_batch(ordered, snap, nt)
+    af = pack_affinity_batch(ordered, snap, nt)
+    sc = pack_score_batch(
+        ordered, snap, nt, None, DEFAULT_WEIGHTS,
+        hard_pod_affinity_weight=1, cluster_affinity_scoring=None,
+    )
+    sp_t = (
+        pad_spread_tensors(sp, padded)
+        if sp is not None
+        else noop_spread_tensors(padded, nt.capacity)
+    )
+    af_t = (
+        pad_affinity_tensors(af, padded)
+        if af is not None
+        else noop_affinity_tensors(padded, nt.capacity)
+    )
+    sc_t = (
+        pad_score_tensors(sc, padded)
+        if sc is not None
+        else noop_score_tensors(padded, nt.capacity)
+    )
+    common = (
+        nt.allocatable, nt.requested, nt.non_zero_requested, nt.valid,
+        req, nzr, rows, midx, active,
+    )
+    return common, tuple(sp_t), tuple(af_t), tuple(sc_t)
+
+
+@pytest.mark.parametrize("seed", [0, 3, 11, 42])
+def test_constrained_kernel_matches_xla(seed):
+    common, sp_t, af_t, sc_t = _packed_problem(seed)
+    a1, r1, z1 = greedy_assign_constrained(
+        *common, sp_t, af_t, sc_t, config=GreedyConfig()
+    )
+    a2, r2, z2 = pallas_constrained_solve(
+        *common, sp_t, af_t, sc_t, config=GreedyConfig(), interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+    np.testing.assert_array_equal(np.asarray(z1), np.asarray(z2))
+
+
+def test_noop_families_match_basic_path():
+    """All-noop family tensors: the constrained kernel must agree with
+    the XLA scan on a plain resource batch too."""
+    common, _, _, _ = _packed_problem(7)
+    padded = common[4].shape[0]
+    n_cap = common[0].shape[0]
+    sp_t = tuple(noop_spread_tensors(padded, n_cap))
+    af_t = tuple(noop_affinity_tensors(padded, n_cap))
+    sc_t = tuple(noop_score_tensors(padded, n_cap))
+    a1, r1, z1 = greedy_assign_constrained(
+        *common, sp_t, af_t, sc_t, config=GreedyConfig()
+    )
+    a2, r2, z2 = pallas_constrained_solve(
+        *common, sp_t, af_t, sc_t, config=GreedyConfig(), interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+    np.testing.assert_array_equal(np.asarray(z1), np.asarray(z2))
