@@ -375,6 +375,11 @@ def _solve_packed_jit(
     arrs = {}
     off = 0
     for name, shape, kind in layout:
+        if isinstance(kind, tuple):
+            base, fill = kind
+            dt = {"Zi": jnp.int32, "Zf": jnp.float32, "Zb": bool}[base]
+            arrs[name] = jnp.full(shape, fill, dtype=dt)
+            continue
         size = 1
         for d in shape:
             size *= d
@@ -430,9 +435,48 @@ def _solve_packed_jit(
     return assignment, req_out, nzr_out, alloc, valid
 
 
-def _piece_kind(arr) -> str:
+class ConstPiece:
+    """Marker operand: uniformly filled with one value (absent
+    constraint families are all-zero counts / all -1 sentinel ids).
+    Materialized on device as a free constant inside the jit instead of
+    riding the upload buffer -- they would otherwise ship ~1MB of
+    constants over the serving link per constrained batch."""
+
+    __slots__ = ("shape", "kind")
+
+    def __init__(self, shape, dtype, fill) -> None:
+        import numpy as _np
+
+        self.shape = tuple(shape)
+        if dtype == _np.float32:
+            base = "f"
+            fill = float(fill)
+        elif dtype == _np.bool_:
+            base = "b"
+            fill = bool(fill)
+        else:
+            base = "i"
+            fill = int(fill)
+        self.kind = ("Z" + base, fill)
+
+    @staticmethod
+    def from_uniform(arr):
+        """ConstPiece for a uniformly-filled array (asserts uniformity:
+        a non-uniform 'noop' tensor silently changing semantics is
+        exactly the bug this guards against)."""
+        import numpy as _np
+
+        arr = _np.asarray(arr)
+        fill = arr.flat[0] if arr.size else 0
+        assert (arr == fill).all(), "ConstPiece source is not uniform"
+        return ConstPiece(arr.shape, arr.dtype, fill)
+
+
+def _piece_kind(arr):
     import numpy as _np
 
+    if isinstance(arr, ConstPiece):
+        return arr.kind
     if arr.dtype == _np.float32:
         return "f"
     if arr.dtype == _np.bool_:
@@ -482,7 +526,13 @@ def solve_packed(
             return arr
         return arr.astype(_np.int32)
 
-    buf = _np.concatenate([as_i32(arr).ravel() for _, arr in pieces])
+    buf = _np.concatenate(
+        [
+            as_i32(arr).ravel()
+            for _, arr in pieces
+            if not isinstance(arr, ConstPiece)
+        ]
+    )
     buf_d = jax.device_put(buf)
     return _solve_packed_jit(
         buf_d, alloc_in, valid_in, req_in, nzr_in,

@@ -350,14 +350,25 @@ class Preemptor:
         nt_rows = np.array(
             [nt.row(name) for name in pack.node_names], dtype=np.int64
         )
+        # potential lists are shared across identical pods (preempt_batch
+        # caches them by statuses identity): materialize each list's
+        # boolean row once instead of a per-pod name-in-set scan
+        pot_rows: Dict[int, np.ndarray] = {}
         for k, pod in enumerate(pods):
             if batch.unsatisfiable[k]:
                 continue  # no pod removal adds a resource dimension
             row = mask_rows[mask_index[k]][nt_rows]
-            potential_names = {ni.node_name for ni in potentials[k]}
-            candidate[k] = row & np.array(
-                [name in potential_names for name in pack.node_names]
-            )
+            pot_key = id(potentials[k])
+            pot_row = pot_rows.get(pot_key)
+            if pot_row is None:
+                pot_row = np.zeros(n, dtype=bool)
+                idxs = [
+                    pack.node_index.get(ni.node_name)
+                    for ni in potentials[k]
+                ]
+                pot_row[[i for i in idxs if i is not None]] = True
+                pot_rows[pot_key] = pot_row
+            candidate[k] = row & pot_row
 
         # pre-existing nominations (in-scan ones ride the kernel carry)
         pod_uids = {p.metadata.uid for p in pods}
@@ -500,10 +511,18 @@ class Preemptor:
         live_pods: List[Pod] = []
         potentials = []
         results = [""] * len(items)
+        # identical failed pods share one statuses dict (the batch path
+        # dedups reason maps per mask row), so a wave computes each
+        # potential-node list ONCE instead of O(pods x nodes) times
+        pot_cache: Dict[int, List] = {}
         for k, (item, pod) in enumerate(zip(items, pods)):
             if pod is None or not self.pod_eligible_to_preempt_others(pod):
                 continue
-            potential = self.nodes_where_preemption_might_help(item[1])
+            pot_key = id(item[1].filtered_nodes_statuses)
+            potential = pot_cache.get(pot_key)
+            if potential is None:
+                potential = self.nodes_where_preemption_might_help(item[1])
+                pot_cache[pot_key] = potential
             if not potential:
                 # no node can ever help: clear any stale nomination (the
                 # host path's to_clear=[pod] branch)
