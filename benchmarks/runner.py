@@ -498,6 +498,17 @@ def to_data_items(results: List[Dict[str, Any]]) -> Dict[str, Any]:
                     "labels": {**labels, "Metric": "PodToBindLatency"},
                 }
             )
+        if r.get("utilization_cpu"):
+            # placement quality (the Churn vs ChurnSinkhorn A/B hinges
+            # on spread, not throughput): per-node cpu utilization
+            # mean / stddev / max after the workload settles
+            items.append(
+                {
+                    "data": dict(r["utilization_cpu"]),
+                    "unit": "fraction",
+                    "labels": {**labels, "Metric": "NodeCpuUtilization"},
+                }
+            )
     return {"version": "v1", "dataItems": items}
 
 

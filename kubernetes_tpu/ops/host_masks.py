@@ -26,6 +26,7 @@ from kubernetes_tpu.api.types import (
     TAINT_EFFECT_NO_SCHEDULE,
     Taint,
 )
+from kubernetes_tpu.cache.node_info import pod_host_ports
 from kubernetes_tpu.cache.snapshot import Snapshot
 from kubernetes_tpu.plugins.nodeaffinity import (
     pod_matches_node_selector_and_affinity,
@@ -54,6 +55,7 @@ def _constraint_signature(pod: Pod) -> Tuple:
         and not spec.node_selector
         and not spec.tolerations
         and (spec.affinity is None or spec.affinity.node_affinity is None)
+        and not any(p.host_port for c in spec.containers for p in c.ports)
     ):
         # the burst common case: no placement constraints at all -- skip
         # the per-pod tuple assembly entirely
@@ -80,7 +82,7 @@ def _constraint_signature(pod: Pod) -> Tuple:
     tols = tuple(
         (t.key, t.operator, t.value, t.effect) for t in spec.tolerations
     )
-    memo = (spec.node_name, sel, aff, tols)
+    memo = (spec.node_name, sel, aff, tols, tuple(pod_host_ports(pod)))
     pod.__dict__["_sig_memo"] = memo
     return memo
 
@@ -131,6 +133,17 @@ def static_mask_compact(
                 if not pod_matches_node_selector_and_affinity(pod, ni):
                     continue
                 if not _tolerates_node_taints(pod, node):
+                    continue
+                # NodePorts (node_ports.go): exclude nodes whose
+                # usedPorts conflict with the pod's host ports -- the
+                # static row covers EXISTING pods; within-batch port
+                # interactions are serialized by the dispatcher
+                # (batch.py routes host-port pods one per solver batch)
+                ports = pod_host_ports(pod)
+                if ports and any(
+                    ni.used_ports.conflicts(ip, proto, port)
+                    for ip, proto, port in ports
+                ):
                     continue
                 row[j] = True
             u = len(rows)

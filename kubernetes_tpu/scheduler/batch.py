@@ -109,11 +109,11 @@ def solver_supported(pod: Pod) -> bool:
         return False
     # REQUIRED pod (anti-)affinity solves on device via the count-tensor
     # replay (ops/affinity.py); preferred terms ride the weighted
-    # count-tensor score family (ops/scoring.py ipa_*)
-    for c in spec.containers:
-        for p in c.ports:
-            if p.host_port:
-                return False
+    # count-tensor score family (ops/scoring.py ipa_*). Host ports solve
+    # on device via the static mask (NodePorts folded into
+    # host_masks.static_mask_compact); the dispatcher serializes
+    # host-port pods one per solver batch so within-batch port
+    # interactions can't double-book (see schedule_batch).
     # volume feasibility (PVC binding, disk conflicts, zone/limit checks)
     # stays host-side
     for v in spec.volumes:
@@ -284,6 +284,21 @@ class BatchScheduler(Scheduler):
                     != pi.pod.spec.scheduler_name
                 ):
                     flush()
+                if any(
+                    p.host_port
+                    for c in pi.pod.spec.containers
+                    for p in c.ports
+                ):
+                    # NodePorts: the static mask row covers existing
+                    # pods only, so each host-port pod solves in its
+                    # OWN batch against a drained (fully committed)
+                    # cluster view -- no within-batch port double-book
+                    flush()
+                    self._drain_pending()
+                    solver_infos.append(pi)
+                    flush()
+                    self._drain_pending()
+                    continue
                 solver_infos.append(pi)
             else:
                 flush()

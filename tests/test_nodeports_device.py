@@ -1,0 +1,133 @@
+"""NodePorts on device: host-port pods solve through the batch solver
+(static-mask fold + one-per-batch serialization, VERDICT r3 missing #6)
+with differential checks against the NodePorts plugin semantics
+(reference nodeports/node_ports.go)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.cache.snapshot import new_snapshot
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.framework.interface import CycleState
+from kubernetes_tpu.ops.host_masks import static_mask
+from kubernetes_tpu.plugins.nodeports import NodePorts
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.tensors import NodeTensorCache
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _port_pod(name, port, ip="", proto="TCP"):
+    w = make_pod(name).container(
+        cpu="100m", memory="128Mi", host_port=port, protocol=proto
+    )
+    if ip:
+        w.pod.spec.containers[0].ports[0].host_ip = ip
+    return w.obj()
+
+
+class TestStaticMaskPortParity:
+    def test_mask_matches_nodeports_plugin(self):
+        nodes = [
+            make_node(f"n{i}").capacity(cpu="8", memory="16Gi", pods=20).obj()
+            for i in range(6)
+        ]
+        existing = []
+        # n0: TCP 8080 wildcard; n1: TCP 8080 on a specific ip;
+        # n2: UDP 8080
+        e0 = _port_pod("e0", 8080)
+        e0.spec.node_name = "n0"
+        e1 = _port_pod("e1", 8080, ip="10.0.0.1")
+        e1.spec.node_name = "n1"
+        e2 = _port_pod("e2", 8080, proto="UDP")
+        e2.spec.node_name = "n2"
+        existing = [e0, e1, e2]
+        snap = new_snapshot(existing, nodes)
+        nt = NodeTensorCache().update(snap)
+        plugin = NodePorts()
+        cases = [
+            _port_pod("w0", 8080),                 # wildcard TCP
+            _port_pod("w1", 8080, ip="10.0.0.1"),  # same specific ip
+            _port_pod("w2", 8080, ip="10.0.0.2"),  # different ip
+            _port_pod("w3", 8080, proto="UDP"),
+            _port_pod("w4", 9090),
+        ]
+        mask = static_mask(cases, snap, nt)
+        for b, pod in enumerate(cases):
+            for ni in snap.list_node_infos():
+                want = plugin.filter(CycleState(), pod, ni) is None
+                got = bool(mask[b][nt.row(ni.node_name)])
+                assert got == want, (
+                    f"{pod.metadata.name} vs {ni.node_name}: "
+                    f"mask={got} plugin={want}"
+                )
+
+
+class TestNodePortsDeviceE2E:
+    def test_host_port_pods_solve_on_device_without_conflicts(self):
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(client, informers, batch=True, max_batch=64)
+        for i in range(8):
+            client.create_node(
+                make_node(f"n{i}").capacity(cpu="8", memory="16Gi", pods=20)
+                .obj()
+            )
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        # 8 pods all wanting hostPort 8080: exactly one per node
+        pods = [_port_pod(f"hp{i}", 8080) for i in range(8)]
+        for p in pods:
+            client.create_pod(p)
+        sched.start()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            cur, _ = client.list_pods()
+            if sum(1 for p in cur if p.spec.node_name) == 8:
+                break
+            time.sleep(0.05)
+        cur, _ = client.list_pods()
+        hosts = [p.spec.node_name for p in cur if p.spec.node_name]
+        assert len(hosts) == 8, f"bound {len(hosts)}/8"
+        assert len(set(hosts)) == 8, f"port conflict: {hosts}"
+        # the device path handled them (no sequential fallback)
+        assert sched.pods_fallback == 0
+        assert sched.pods_solved_on_device >= 8
+        sched.stop()
+        informers.stop()
+
+    def test_ninth_pod_unschedulable_when_ports_exhausted(self):
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(client, informers, batch=True, max_batch=64)
+        for i in range(3):
+            client.create_node(
+                make_node(f"n{i}").capacity(cpu="8", memory="16Gi", pods=20)
+                .obj()
+            )
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        pods = [_port_pod(f"hp{i}", 9000) for i in range(4)]
+        for p in pods:
+            client.create_pod(p)
+        sched.start()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            cur, _ = client.list_pods()
+            if sum(1 for p in cur if p.spec.node_name) >= 3:
+                break
+            time.sleep(0.05)
+        time.sleep(1.0)
+        cur, _ = client.list_pods()
+        bound = [p for p in cur if p.spec.node_name]
+        assert len(bound) == 3, f"bound {len(bound)}, want exactly 3"
+        assert len({p.spec.node_name for p in bound}) == 3
+        sched.stop()
+        informers.stop()
