@@ -40,31 +40,6 @@ _BIG = 1 << 30
 _IMAX = (1 << 31) - 1
 
 
-def _fits_rows(free_rows, podreq_ref, base, r):
-    """assignment._fits against per-dimension [1, N] row lists with SMEM
-    per-pod scalars (row lists avoid scatter-style updates, which Mosaic
-    does not lower)."""
-    fits_all = None
-    fits_pods = None
-    all_zero = None
-    for d in range(r):
-        s = podreq_ref[base + d]
-        ok = s <= free_rows[d]
-        if d >= NUM_FIXED_DIMS:
-            ok = ok | (s == 0)
-        fits_all = ok if fits_all is None else (fits_all & ok)
-        if d == PODS:
-            fits_pods = ok
-        else:
-            zero_d = s == 0
-            all_zero = zero_d if all_zero is None else (all_zero & zero_d)
-    return jnp.where(
-        all_zero,
-        fits_pods.astype(jnp.int32),
-        fits_all.astype(jnp.int32),
-    ) > 0
-
-
 def _preempt_kernel(
     podreq_ref,    # SMEM [chunk*R] int32
     podprio_ref,   # SMEM [chunk] int32

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from kubernetes_tpu.api.types import Binding, POD_GROUP_LABEL, Pod
+from kubernetes_tpu.cache.node_info import pod_host_ports
 from kubernetes_tpu.framework.interface import (
     CycleState,
     FitError,
@@ -1196,7 +1197,16 @@ class BatchScheduler(Scheduler):
                 # nodes_where_preemption_might_help prunes like the
                 # reference instead of scanning every node
                 statuses = {}
-                if mask_info is not None and mask_info[0] is not None:
+                # host-port pods: the static row folds NodePorts in, and
+                # a port conflict IS resolvable by evicting the holder
+                # (generic_scheduler.go:940 re-runs filters with victims
+                # removed) -- leave statuses empty so preemption scans
+                # every node instead of wrongly pruning them
+                if (
+                    mask_info is not None
+                    and mask_info[0] is not None
+                    and not pod_host_ports(pi.pod)
+                ):
                     m_rows, m_idx = mask_info
                     ridx = int(m_idx[k])
                     statuses = statuses_by_row.get(ridx)
@@ -1332,14 +1342,21 @@ class BatchScheduler(Scheduler):
         plugins, unreserve on failure) -- the framework contract is
         per-pod state, and a fresh snapshot-seeded state is exactly what
         the eager path carried for these pods."""
-        prof0 = items[0][0]
+        # the pre_bind gate must consider every profile in the bulk:
+        # schedule_batch flushes on scheduler_name change today, but a
+        # mixed bulk silently skipping another profile's PreBind plugins
+        # would be a correctness bug, not a perf loss
+        profs = {id(t[0]): t[0] for t in items}
+        any_pre_bind = any(
+            prof.relevance_entries("pre_bind") for prof in profs.values()
+        )
 
         def mk_state():
             state = CycleState()
             state.write(SNAPSHOT_STATE_KEY, snapshot)
             return state
 
-        if prof0.relevance_entries("pre_bind"):
+        if any_pre_bind:
             ready = []
             for prof, state, pi, assumed, host in items:
                 if prof.plugins_relevant("pre_bind", assumed):
@@ -1393,13 +1410,17 @@ class BatchScheduler(Scheduler):
             return
         with timeline.span("finish_binding_bulk"):
             self.cache.finish_binding_bulk(bound_assumed)
-        if prof0.has_plugins("post_bind"):
+        if any(p.has_plugins("post_bind") for p in profs.values()):
             for prof, state, pi, assumed, host in bound:
-                prof.run_post_bind_plugins(
-                    state if state is not None else mk_state(),
-                    assumed, host,
-                )
-        recorder = prof0.recorder
+                if prof.has_plugins("post_bind"):
+                    prof.run_post_bind_plugins(
+                        state if state is not None else mk_state(),
+                        assumed, host,
+                    )
+        # single-profile bulks take the batched-recorder fast path; a
+        # mixed bulk passes recorder=None so _emit_bound's fallback
+        # routes each event through the pod's own profile recorder
+        recorder = bound[0][0].recorder if len(profs) == 1 else None
         with timeline.span("events+metrics"):
             self._emit_bound(recorder, bound)
 

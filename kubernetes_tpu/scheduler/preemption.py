@@ -20,7 +20,7 @@ from typing import Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api.selectors import labels_match_selector
 from kubernetes_tpu.api.types import Pod, PodDisruptionBudget
-from kubernetes_tpu.cache.node_info import NodeInfo
+from kubernetes_tpu.cache.node_info import NodeInfo, pod_host_ports
 from kubernetes_tpu.framework.interface import (
     CycleState,
     FitError,
@@ -282,6 +282,13 @@ class Preemptor:
         # the host oracle or it would evict victims for a node its
         # constraint still rejects
         if pod.spec.topology_spread_constraints:
+            return False
+        # host-port preemptors too: static_mask_compact bakes existing
+        # port conflicts into the candidate mask, so a node whose only
+        # remedy is evicting the current port holder is never searched.
+        # The reference re-runs NodePorts with victims removed
+        # (generic_scheduler.go:940); the host oracle does the same here.
+        if pod_host_ports(pod):
             return False
         a = pod.spec.affinity
         if a is not None and (

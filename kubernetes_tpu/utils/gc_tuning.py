@@ -45,22 +45,33 @@ class GCBatchGuard:
     #: generation collect runs at most this often, so stray cycles from a
     #: long active phase cannot grow RSS without bound
     ACTIVE_COLLECT_INTERVAL_S = 10.0
+    #: every Nth in-flight collect runs the FULL collector: gen-1-only
+    #: passes promote surviving cycles to gen 2, which would otherwise
+    #: wait for an idle transition that sustained load never reaches
+    FULL_COLLECT_EVERY = 6
 
     def __init__(self) -> None:
         self._active = False
         self._last_collect = 0.0
+        self._active_collects = 0
 
     def active(self) -> None:
         if not self._active:
             gc.disable()
             self._active = True
             self._last_collect = _time.monotonic()
+            self._active_collects = 0
             return
         now = _time.monotonic()
         if now - self._last_collect >= self.ACTIVE_COLLECT_INTERVAL_S:
             # explicit collect works while the collector is disabled;
-            # gen-1 keeps the pause bounded (young objects only)
-            gc.collect(1)
+            # gen-1 keeps the pause bounded (young objects only), with a
+            # periodic full pass to drain gen-2 promotions
+            self._active_collects += 1
+            if self._active_collects % self.FULL_COLLECT_EVERY == 0:
+                gc.collect()
+            else:
+                gc.collect(1)
             self._last_collect = now
 
     def idle(self) -> None:

@@ -292,3 +292,60 @@ def test_batch_preemption_end_to_end_device():
     assert bound, "high-priority pod never bound after preemption"
     assert sched.preemptor.device_preemptions >= 1
     assert sched.preemptor.host_preemptions == 0
+
+
+def test_host_port_preemptor_takes_host_oracle():
+    """A host-port pod whose only remedy is evicting the current port
+    holder must preempt via the HOST oracle: the device victim search's
+    candidate mask bakes existing port conflicts in and cannot model
+    ports freed by eviction (the reference re-runs NodePorts with
+    victims removed, generic_scheduler.go:940)."""
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(client, informers, batch=True, max_batch=16)
+    client.create_node(
+        make_node("only").capacity(cpu="8", memory="16Gi", pods=10).obj()
+    )
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    client.create_pod(
+        make_pod("holder").priority(0)
+        .container(cpu="100m", memory="64Mi", host_port=8080).obj()
+    )
+    sched.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            if client.get_pod("default", "holder").spec.node_name:
+                break
+        except KeyError:
+            pass
+        time.sleep(0.05)
+    sched.wait_for_inflight_binds()
+    client.create_pod(
+        make_pod("vip").priority(1000)
+        .container(cpu="100m", memory="64Mi", host_port=8080).obj()
+    )
+    deadline = time.time() + 30
+    ok = False
+    while time.time() < deadline:
+        try:
+            vip = client.get_pod("default", "vip")
+        except KeyError:
+            time.sleep(0.05)
+            continue
+        try:
+            client.get_pod("default", "holder")
+            holder_gone = False
+        except KeyError:
+            holder_gone = True
+        if vip.spec.node_name == "only" and holder_gone:
+            ok = True
+            break
+        time.sleep(0.05)
+    sched.stop()
+    informers.stop()
+    assert ok, "vip never preempted the host-port holder"
+    assert sched.preemptor.host_preemptions >= 1
