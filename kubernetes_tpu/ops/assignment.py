@@ -346,7 +346,8 @@ _N_SCORING = 20
 
 
 @partial(
-    jax.jit, static_argnames=("layout", "config", "mode", "use_pallas")
+    jax.jit,
+    static_argnames=("layout", "config", "mode", "use_pallas", "caps"),
 )
 def _solve_packed_jit(
     buf: jnp.ndarray,  # [T] int32: every uploaded piece, concatenated
@@ -358,6 +359,7 @@ def _solve_packed_jit(
     config: GreedyConfig = GreedyConfig(),
     mode: str = "greedy",
     use_pallas: bool = False,
+    caps=None,  # static pallas_constrained.Caps family specialization
 ):
     """Solve from a SINGLE uploaded buffer.
 
@@ -405,12 +407,13 @@ def _solve_packed_jit(
         scoring = tuple(arrs[f"sc{i}"] for i in range(_N_SCORING))
         if use_pallas:
             # fused constrained kernel (ops/pallas_constrained.py):
-            # ~4.2x the XLA constrained scan per solve on the chip
+            # ~4.2x the XLA constrained scan per solve on the chip,
+            # specialized to the batch's active families via caps
             from kubernetes_tpu.ops.pallas_constrained import (
                 pallas_constrained_solve,
             )
 
-            c_solver = pallas_constrained_solve
+            c_solver = partial(pallas_constrained_solve, caps=caps)
         else:
             c_solver = greedy_assign_constrained
         assignment, req_out, nzr_out = c_solver(
@@ -484,6 +487,60 @@ def _piece_kind(arr):
     return "i"
 
 
+def caps_for_families(sp_t, af_t, sc_t, sp_present, af_present, sc_present):
+    """Derive the kernel specialization Caps from the padded family
+    tuples. Row usage comes from the small per-row/per-pod arrays,
+    except ipa (scanned from its node-value rows); usage only matters
+    for the rare escalation past DEFAULT_LIVE."""
+    import numpy as _np
+
+    from kubernetes_tpu.ops.pallas_constrained import live_caps
+
+    def max_plus_one(a):
+        a = _np.asarray(a)
+        return 0 if a.size == 0 else int(a.max()) + 1
+
+    def key_rows(a):
+        return int(_np.count_nonzero(_np.asarray(a) >= 0))
+
+    sp_used = max_plus_one(sp_t[3]) if sp_present else 0
+    af_used = (
+        (key_rows(af_t[2]), key_rows(af_t[7]), key_rows(af_t[11]))
+        if af_present else (0, 0, 0)
+    )
+    if sc_present:
+        rp_rows = _np.flatnonzero(
+            (_np.asarray(sc_t[13]) >= 0).any(axis=1)
+        )
+        sc_used = (
+            max_plus_one(sc_t[11]),
+            max_plus_one(rp_rows),
+            max_plus_one(sc_t[7]),
+        )
+    else:
+        sc_used = (0, 0, 0)
+    return live_caps(
+        sp_present, af_present, sc_present, sp_used, af_used, sc_used
+    )
+
+
+def _constrained_caps(pieces_by_name):
+    """Caps from the HOST-side packed pieces (a ConstPiece family piece
+    marks that family absent)."""
+
+    def fam(prefix, count):
+        arrs = [pieces_by_name.get(f"{prefix}{i}") for i in range(count)]
+        present = not any(isinstance(a, ConstPiece) for a in arrs)
+        return arrs, present
+
+    sp_t, sp_present = fam("sp", _N_SPREAD)
+    af_t, af_present = fam("af", _N_AFFINITY)
+    sc_t, sc_present = fam("sc", _N_SCORING)
+    return caps_for_families(
+        sp_t, af_t, sc_t, sp_present, af_present, sc_present
+    )
+
+
 def solve_packed(
     pieces,  # ordered [(name, ndarray)] to ride the buffer
     alloc_in,
@@ -497,7 +554,12 @@ def solve_packed(
     (int32 / bool / float32 -- see _solve_packed_jit's kind codes) and
     dispatches one upload + one solve. The greedy mode runs the fused
     Pallas kernel on TPU backends (KTPU_PALLAS=0 opts out; batch shapes
-    the kernel's SMEM chunking can't tile fall back to the XLA scan)."""
+    the kernel's SMEM chunking can't tile fall back to the XLA scan).
+    Constrained batches pick a family specialization (Caps) from the
+    packed pieces and gate on an explicit VMEM estimate -- node count,
+    mask-row diversity U, score-signature count S and zone count all
+    contribute, so a batch that cannot fit falls back to the XLA scan
+    instead of failing Mosaic compilation (ADVICE r4)."""
     import numpy as _np
 
     layout = tuple(
@@ -505,19 +567,34 @@ def solve_packed(
     )
     b = next(s for n, s, _ in layout if n == "req")[0]
     if alloc_in is not None:
-        n_cap = alloc_in.shape[0]
+        n_cap, r_dims = alloc_in.shape
     else:
-        n_cap = next(s for n, s, _ in layout if n == "alloc")[0]
+        n_cap, r_dims = next(s for n, s, _ in layout if n == "alloc")
     use_pallas = (
         mode in ("greedy", "constrained")
         and _os.environ.get("KTPU_PALLAS", "1") != "0"
         and jax.default_backend() == "tpu"
         and (b <= 1024 or b % 1024 == 0)
-        # the constrained kernel keeps ~500 [rows, N] count/value
-        # matrices VMEM-resident (~2KB/node); past ~5.6k nodes it
-        # exceeds the ~16MB VMEM budget and the XLA scan takes over
-        and (mode != "constrained" or n_cap <= 5632)
     )
+    caps = None
+    if mode == "constrained" and use_pallas:
+        from kubernetes_tpu.ops.pallas_constrained import (
+            VMEM_BUDGET,
+            constrained_vmem_bytes,
+        )
+
+        by_name = dict(pieces)
+        caps = _constrained_caps(by_name)
+        u = next(s for n, s, _ in layout if n == "rows")[0]
+        s_sig = next(s for n, s, _ in layout if n == "sc0")[0]
+        z = next(s for n, s, _ in layout if n == "sc5")[1]
+        v_sp = next(s for n, s, _ in layout if n == "sp0")[1]
+        est = constrained_vmem_bytes(
+            n_cap, r_dims, u, s_sig, z, v_sp, caps, chunk=min(b, 1024)
+        )
+        if est > VMEM_BUDGET:
+            use_pallas = False
+            caps = None
 
     def as_i32(arr):
         if arr.dtype == _np.float32:
@@ -534,10 +611,27 @@ def solve_packed(
         ]
     )
     buf_d = jax.device_put(buf)
-    return _solve_packed_jit(
-        buf_d, alloc_in, valid_in, req_in, nzr_in,
-        layout=layout, config=config, mode=mode, use_pallas=use_pallas,
-    )
+    try:
+        return _solve_packed_jit(
+            buf_d, alloc_in, valid_in, req_in, nzr_in,
+            layout=layout, config=config, mode=mode,
+            use_pallas=use_pallas, caps=caps,
+        )
+    except Exception:  # noqa: BLE001 - Mosaic lowering is the risk here
+        if not use_pallas:
+            raise
+        # the VMEM estimate is conservative but not exact; a lowering
+        # failure must degrade to the XLA scan, not kill the batch
+        import logging as _logging
+
+        _logging.getLogger(__name__).exception(
+            "pallas solve lowering failed; falling back to the XLA scan"
+        )
+        return _solve_packed_jit(
+            buf_d, alloc_in, valid_in, req_in, nzr_in,
+            layout=layout, config=config, mode=mode,
+            use_pallas=False, caps=None,
+        )
 
 
 def affinity_node_ok(

@@ -29,17 +29,27 @@ Key design moves (vs the value-space XLA formulation):
 - **Aliased count states.** Initial count matrices are inputs aliased to
   the output refs (input_output_aliases), so each tensor is resident
   once.
+- **Family specialization (the VMEM-cap breaker).** The kernel is a
+  template over per-family row caps ``Caps``: a family the batch does
+  not use contributes ZERO refs, zero VMEM and zero per-step work, and
+  active families are sliced to a bucketed row count instead of the
+  packer maximum. A spread-only 20k-node batch carries ~100 node-sized
+  rows instead of ~500, so the fused kernel -- not the XLA scan -- runs
+  far past the old ~5.6k-node all-family ceiling. The caller
+  (ops/assignment.solve_packed) picks caps from the packed batch and
+  gates on an explicit VMEM estimate (constrained_vmem_bytes).
 
 Semantics are the constrained scan's, family by family (citations in
 ops/assignment.py greedy_assign_constrained); the differential tests
 (tests/test_pallas_constrained.py) run this kernel in interpreter mode
-against the XLA path on randomized constrained batches.
+against the XLA path on randomized constrained batches, at full and at
+reduced caps.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -53,9 +63,9 @@ from kubernetes_tpu.tensors.node_tensor import NUM_FIXED_DIMS, PODS
 _BIG = 1 << 30
 _BIG_SOFT = float(1 << 20)
 
-# pp (per-pod param matrix) row layout: static offsets, f32 values.
-# Sized from the packers' caps (ops/topology.py, ops/affinity.py,
-# ops/scoring.py); the wrapper asserts the incoming shapes still match.
+# Packer maximums (ops/topology.py, ops/affinity.py, ops/scoring.py);
+# the wrapper asserts the incoming shapes still match, then slices each
+# family down to the requested caps.
 _G_SP = 16      # topology.MAX_GROUPS
 _RA = 16        # affinity.MAX_AFF_ROWS
 _RT = 16        # affinity.MAX_ANTI_ROWS
@@ -64,22 +74,93 @@ _GT = 16        # scoring.MAX_SOFT_GROUPS
 _RP = 16        # scoring.MAX_IPA_ROWS
 _G_SEL = 8      # scoring.MAX_SEL_GROUPS
 
-_OFF_SP_LIMIT = 0                      # [G_SP] skew-self limit (big = off)
-_OFF_SP_MATCH = _OFF_SP_LIMIT + _G_SP  # [G_SP]
-_OFF_AFF_ACT = _OFF_SP_MATCH + _G_SP   # [RA]
-_OFF_AFF_BUMP = _OFF_AFF_ACT + _RA     # [RA]
-_OFF_ANTI_ACT = _OFF_AFF_BUMP + _RA    # [RT]
-_OFF_ANTI_BUMP = _OFF_ANTI_ACT + _RT   # [RT]
-_OFF_EXIST_MATCH = _OFF_ANTI_BUMP + _RT  # [RE]
-_OFF_EXIST_BUMP = _OFF_EXIST_MATCH + _RE  # [RE]
-_OFF_SOFT_W = _OFF_EXIST_BUMP + _RE    # [GT]
-_OFF_SOFT_MATCH = _OFF_SOFT_W + _GT    # [GT]
-_OFF_IPA_W = _OFF_SOFT_MATCH + _GT     # [RP]
-_OFF_IPA_MATCH = _OFF_IPA_W + _RP      # [RP]
-_OFF_IPA_BUMP = _OFF_IPA_MATCH + _RP   # [RP]
-_OFF_SEL_MATCH = _OFF_IPA_BUMP + _RP   # [G_SEL]
-_PP_ROWS = _OFF_SEL_MATCH + _G_SEL
-_PP_PAD = ((_PP_ROWS + 7) // 8) * 8
+
+class Caps(NamedTuple):
+    """Static per-family row caps for one kernel specialization. A zero
+    drops the family from the kernel entirely."""
+
+    g_sp: int = _G_SP   # hard-spread groups
+    ra: int = _RA       # incoming-affinity rows
+    rt: int = _RT       # incoming-anti-affinity rows
+    re: int = _RE       # existing-pod anti-affinity rows
+    gt: int = _GT       # soft-spread groups
+    rp: int = _RP       # preferred inter-pod affinity rows
+    g_sel: int = _G_SEL  # selector-spread groups
+
+
+FULL_CAPS = Caps()
+
+#: fixed row caps for a LIVE family: caps are tied to the three packer
+#: families (spread / affinity / scoring) rather than sized per batch,
+#: so the whole specialization space is 2^3 combos (all warmable by
+#: BatchScheduler.warmup) plus a rare escalated variant per family when
+#: a batch's row usage exceeds these defaults
+DEFAULT_LIVE = Caps(g_sp=8, ra=8, rt=8, re=16, gt=8, rp=8, g_sel=8)
+
+
+def live_caps(
+    sp_present: bool,
+    af_present: bool,
+    sc_present: bool,
+    sp_used: int = 0,
+    af_used: Tuple[int, int, int] = (0, 0, 0),
+    sc_used: Tuple[int, int, int] = (0, 0, 0),
+) -> Caps:
+    """Caps for a batch: per packer family, absent -> 0 rows, present ->
+    the DEFAULT_LIVE sizes, escalated to the packer maxima when usage
+    exceeds them (usage beyond the maxima never reaches the solver --
+    the packers route such pods to the host path)."""
+    d = DEFAULT_LIVE
+    if not sp_present:
+        g_sp = 0
+    else:
+        g_sp = d.g_sp if sp_used <= d.g_sp else _G_SP
+    if not af_present:
+        ra = rt = re = 0
+    elif (
+        af_used[0] <= d.ra and af_used[1] <= d.rt and af_used[2] <= d.re
+    ):
+        ra, rt, re = d.ra, d.rt, d.re
+    else:
+        ra, rt, re = _RA, _RT, _RE
+    if not sc_present:
+        gt = rp = g_sel = 0
+    elif (
+        sc_used[0] <= d.gt and sc_used[1] <= d.rp
+        and sc_used[2] <= d.g_sel
+    ):
+        gt, rp, g_sel = d.gt, d.rp, d.g_sel
+    else:
+        gt, rp, g_sel = _GT, _RP, _G_SEL
+    return Caps(g_sp, ra, rt, re, gt, rp, g_sel)
+
+
+def _pp_layout(caps: Caps) -> Tuple[dict, int]:
+    """Per-pod param matrix row layout for one specialization: offsets
+    into the fat [PP_PAD, B] matrix, sized by the active caps only."""
+    off = {}
+    cur = 0
+    for name, size in (
+        ("sp_limit", caps.g_sp),
+        ("sp_match", caps.g_sp),
+        ("aff_act", caps.ra),
+        ("aff_bump", caps.ra),
+        ("anti_act", caps.rt),
+        ("anti_bump", caps.rt),
+        ("exist_match", caps.re),
+        ("exist_bump", caps.re),
+        ("soft_w", caps.gt),
+        ("soft_match", caps.gt),
+        ("ipa_w", caps.rp),
+        ("ipa_match", caps.rp),
+        ("ipa_bump", caps.rp),
+        ("sel_match", caps.g_sel),
+    ):
+        if size:
+            off[name] = cur
+            cur += size
+    pad = max(((cur + 7) // 8) * 8, 8)
+    return off, pad
 
 
 def _col(pp_block, t, chunk):
@@ -99,74 +180,81 @@ def _at_choice(mat_f32, onehot_lane):
 
 
 def _constrained_kernel(
-    # SMEM per-pod scalars
-    midx_ref,       # [chunk] int32
-    podreq_ref,     # [chunk*R] int32
-    podnzr_ref,     # [chunk*2] int32
-    active_ref,     # [chunk] int32
-    sig_ref,        # [chunk] int32 score signature row
-    selg_ref,       # [chunk] int32 selector-spread group (-1 none)
-    selfm_ref,      # [chunk] int32 affinity self-match
-    flags_ref,      # [8] int32: w_na w_tt w_sel w_soft w_ipa ipa_live
-    # VMEM static inputs
-    alloc_ref,      # [R, N]
-    valid_ref,      # [1, N]
-    rows_ref,       # [U, N]
-    pp_ref,         # [PP_PAD, chunk] f32 per-pod params (transposed)
-    sp_nv_ref,      # [G_SP, N] spread node values (-1 none)
-    sp_vvalid_ref,  # [G_SP, V] value_valid
-    vals_aff_ref,   # [RA, N]
-    vals_anti_ref,  # [RT, N]
-    vals_exist_ref,  # [RE, N]
-    direct_ref,     # [S, N] f32 pre-weighted static score rows
-    nodeaff_ref,    # [S, N] f32
-    taint_ref,      # [S, N] f32
-    zone_oh_ref,    # [Z, N] f32
-    zone_id_ref,    # [1, N] int32 (-1 none)
-    soft_nv_ref,    # [GT, N]
-    ipa_nv_ref,     # [RP, N]
-    # aliased count states (inputs below are the initial values)
-    req_in_ref, nzr_in_ref, sp_node_in_ref, sp_val_in_ref,
-    aff_node_in_ref, aff_tot_in_ref, anti_in_ref, exist_in_ref,
-    sel_in_ref, soft_in_ref, ipa_in_ref, ipaw_in_ref,
-    # outputs
-    asg_ref,        # OUT SMEM [chunk]
-    req_ref,        # OUT [R, N]  (aliased to req_in)
-    nzr_ref,        # OUT [2, N]
-    sp_node_ref,    # OUT [G_SP, N]
-    sp_val_ref,     # OUT [G_SP, V]
-    aff_node_ref,   # OUT [RA, N]
-    aff_tot_ref,    # OUT [RA, 128]
-    anti_ref,       # OUT [RT, N]
-    exist_ref,      # OUT [RE, N]
-    sel_ref,        # OUT [G_SEL, N]
-    soft_ref,       # OUT [GT, N]
-    ipa_ref,        # OUT [RP, N]
-    ipaw_ref,       # OUT [RP, N]
-    *,
+    *refs,
     chunk: int,
     r: int,
+    caps: Caps,
+    iidx: Tuple[Tuple[str, int], ...],
+    oidx: Tuple[Tuple[str, int], ...],
+    nin: int,
     w_least: int,
     w_balanced: int,
     w_most: int,
 ):
+    ii = dict(iidx)
+    oi = dict(oidx)
+
+    def I(name):  # noqa: E743 - deliberate short ref accessor
+        return refs[ii[name]]
+
+    def O(name):
+        return refs[nin + oi[name]]
+
+    pp_off, _ = _pp_layout(caps)
+    g_sp, ra, rt, re, gt, rp, g_sel = caps
+
+    alloc_ref = I("alloc")
     n = alloc_ref.shape[1]
-    v = sp_val_ref.shape[1]
     col = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
-    val_iota = jax.lax.broadcasted_iota(jnp.int32, (_G_SP, v), 1)
     alloc = alloc_ref[:, :]
-    caps = alloc[:2, :].astype(jnp.float32)
-    cap_safe = jnp.maximum(caps, 1.0)
-    valid = valid_ref[0:1, :] > 0
-    sp_nv = sp_nv_ref[:, :]
-    sp_vvalid = sp_vvalid_ref[:, :] > 0
-    vals_aff = vals_aff_ref[:, :]
-    vals_anti = vals_anti_ref[:, :]
-    vals_exist = vals_exist_ref[:, :]
-    zone_oh = zone_oh_ref[:, :]
-    zone_id = zone_id_ref[0:1, :]
-    soft_nv = soft_nv_ref[:, :]
-    ipa_nv = ipa_nv_ref[:, :]
+    caps_rows = alloc[:2, :].astype(jnp.float32)
+    cap_safe = jnp.maximum(caps_rows, 1.0)
+    valid = I("valid")[0:1, :] > 0
+    rows_ref = I("rows")
+    pp_ref = I("pp")
+    midx_ref = I("midx")
+    podreq_ref = I("podreq")
+    podnzr_ref = I("podnzr")
+    active_ref = I("active")
+    sig_ref = I("sig")
+    flags_ref = I("flags")
+    req_ref = O("req")
+    nzr_ref = O("nzr")
+    asg_ref = O("asg")
+
+    if g_sp:
+        sp_nv = I("sp_nv")[:, :]
+        sp_vvalid = I("sp_vvalid")[:, :] > 0
+        sp_node_ref = O("sp_node")
+        sp_val_ref = O("sp_val")
+        v = sp_val_ref.shape[1]
+        val_iota = jax.lax.broadcasted_iota(jnp.int32, (g_sp, v), 1)
+    if ra:
+        vals_aff = I("vals_aff")[:, :]
+        aff_node_ref = O("aff_node")
+        aff_tot_ref = O("aff_tot")
+        selfm_ref = I("selfm")
+    if rt:
+        vals_anti = I("vals_anti")[:, :]
+        anti_ref = O("anti")
+    if re:
+        vals_exist = I("vals_exist")[:, :]
+        exist_ref = O("exist")
+    direct_ref = I("direct")
+    nodeaff_ref = I("nodeaff")
+    taint_ref = I("taint")
+    if g_sel:
+        zone_oh = I("zone_oh")[:, :]
+        zone_id = I("zone_id")[0:1, :]
+        sel_ref = O("sel")
+        selg_ref = I("selg")
+    if gt:
+        soft_nv = I("soft_nv")[:, :]
+        soft_ref = O("soft")
+    if rp:
+        ipa_nv = I("ipa_nv")[:, :]
+        ipa_ref = O("ipa")
+        ipaw_ref = O("ipaw")
     w_na = flags_ref[0].astype(jnp.float32)
     w_tt = flags_ref[1].astype(jnp.float32)
     w_sel = flags_ref[2].astype(jnp.float32)
@@ -210,43 +298,52 @@ def _constrained_kernel(
         feasible = fits & smask & valid
 
         # -- hard topology spread (filtering.go:322) --------------------
-        sp_limit = pcol[_OFF_SP_LIMIT:_OFF_SP_LIMIT + _G_SP]  # [G, 1]
-        sp_act = sp_limit < big
-        min_v = jnp.min(
-            jnp.where(sp_vvalid, sp_val_ref[:, :].astype(jnp.float32), big),
-            axis=1, keepdims=True,
-        )  # [G, 1]
-        sp_cnt = sp_node_ref[:, :].astype(jnp.float32)
-        sp_ok_g = (sp_nv >= 0) & (sp_cnt - min_v <= sp_limit)
-        spread_bad = (sp_act & ~sp_ok_g).astype(jnp.int32).max(
-            axis=0, keepdims=True
-        ) > 0
-        feasible = feasible & ~spread_bad
+        if g_sp:
+            sp_limit = pcol[pp_off["sp_limit"]:pp_off["sp_limit"] + g_sp]
+            sp_act = sp_limit < big
+            min_v = jnp.min(
+                jnp.where(
+                    sp_vvalid, sp_val_ref[:, :].astype(jnp.float32), big
+                ),
+                axis=1, keepdims=True,
+            )  # [G, 1]
+            sp_cnt = sp_node_ref[:, :].astype(jnp.float32)
+            sp_ok_g = (sp_nv >= 0) & (sp_cnt - min_v <= sp_limit)
+            spread_bad = (sp_act & ~sp_ok_g).astype(jnp.int32).max(
+                axis=0, keepdims=True
+            ) > 0
+            feasible = feasible & ~spread_bad
 
         # -- required (anti-)affinity (filtering.go:404-516) ------------
-        aff_act = pcol[_OFF_AFF_ACT:_OFF_AFF_ACT + _RA] > 0  # [RA, 1]
-        aff_pos = (vals_aff >= 0) & (aff_node_ref[:, :] > 0)
-        aff_all = (aff_act & ~aff_pos).astype(jnp.int32).max(
-            axis=0, keepdims=True
-        ) == 0
-        row_tot = aff_tot_ref[:, 0:1]  # [RA, 1] f32
-        total = jnp.sum(jnp.where(aff_act, row_tot, 0.0))
-        self_match = selfm_ref[t] > 0
-        aff_ok = aff_all | ((total == 0.0) & self_match)
+        if ra:
+            aff_act = pcol[pp_off["aff_act"]:pp_off["aff_act"] + ra] > 0
+            aff_pos = (vals_aff >= 0) & (aff_node_ref[:, :] > 0)
+            aff_all = (aff_act & ~aff_pos).astype(jnp.int32).max(
+                axis=0, keepdims=True
+            ) == 0
+            row_tot = aff_tot_ref[:, 0:1]  # [RA, 1] f32
+            total = jnp.sum(jnp.where(aff_act, row_tot, 0.0))
+            self_match = selfm_ref[t] > 0
+            aff_ok = aff_all | ((total == 0.0) & self_match)
+            feasible = feasible & aff_ok
 
-        anti_act = pcol[_OFF_ANTI_ACT:_OFF_ANTI_ACT + _RT] > 0
-        anti_bad_rows = (vals_anti >= 0) & (anti_ref[:, :] > 0)
-        anti_bad = (anti_act & anti_bad_rows).astype(jnp.int32).max(
-            axis=0, keepdims=True
-        ) > 0
+        if rt:
+            anti_act = pcol[pp_off["anti_act"]:pp_off["anti_act"] + rt] > 0
+            anti_bad_rows = (vals_anti >= 0) & (anti_ref[:, :] > 0)
+            anti_bad = (anti_act & anti_bad_rows).astype(jnp.int32).max(
+                axis=0, keepdims=True
+            ) > 0
+            feasible = feasible & ~anti_bad
 
-        exist_match = pcol[_OFF_EXIST_MATCH:_OFF_EXIST_MATCH + _RE] > 0
-        exist_bad_rows = (vals_exist >= 0) & (exist_ref[:, :] > 0)
-        exist_bad = (exist_match & exist_bad_rows).astype(jnp.int32).max(
-            axis=0, keepdims=True
-        ) > 0
-
-        feasible = feasible & aff_ok & ~anti_bad & ~exist_bad
+        if re:
+            exist_match = (
+                pcol[pp_off["exist_match"]:pp_off["exist_match"] + re] > 0
+            )
+            exist_bad_rows = (vals_exist >= 0) & (exist_ref[:, :] > 0)
+            exist_bad = (exist_match & exist_bad_rows).astype(
+                jnp.int32
+            ).max(axis=0, keepdims=True) > 0
+            feasible = feasible & ~exist_bad
 
         # -- resource scores (ops/scores.py arithmetic) -----------------
         p0 = podnzr_ref[t * 2].astype(jnp.float32)
@@ -261,14 +358,16 @@ def _constrained_kernel(
         score = jnp.zeros((1, n), dtype=jnp.float32)
         if w_least:
             raw = jnp.floor(
-                (caps - req_tot) * MAX_NODE_SCORE / cap_safe + _EPS
+                (caps_rows - req_tot) * MAX_NODE_SCORE / cap_safe + _EPS
             )
-            per_dim = jnp.where((caps == 0) | (req_tot > caps), 0.0, raw)
+            per_dim = jnp.where(
+                (caps_rows == 0) | (req_tot > caps_rows), 0.0, raw
+            )
             score += w_least * jnp.floor(
                 jnp.sum(per_dim, axis=0)[None] / 2.0 + _EPS
             )
         if w_balanced:
-            frac = jnp.where(caps == 0, 1.0, req_tot / cap_safe)
+            frac = jnp.where(caps_rows == 0, 1.0, req_tot / cap_safe)
             diff = jnp.abs(frac[0:1, :] - frac[1:2, :])
             ba = jnp.trunc((1.0 - diff) * MAX_NODE_SCORE + _EPS)
             ba = jnp.where(
@@ -277,7 +376,9 @@ def _constrained_kernel(
             score += w_balanced * ba
         if w_most:
             raw = jnp.floor(req_tot * MAX_NODE_SCORE / cap_safe + _EPS)
-            per_dim = jnp.where((caps == 0) | (req_tot > caps), 0.0, raw)
+            per_dim = jnp.where(
+                (caps_rows == 0) | (req_tot > caps_rows), 0.0, raw
+            )
             score += w_most * jnp.floor(
                 jnp.sum(per_dim, axis=0)[None] / 2.0 + _EPS
             )
@@ -303,93 +404,98 @@ def _constrained_kernel(
         )
 
         # SelectorSpread (default_pod_topology_spread.go:107)
-        selg = selg_ref[t]
-        sel_raw = sel_ref[pl.ds(jnp.maximum(selg, 0), 1), :].astype(
-            jnp.float32
-        )
-        sel_feas = sel_raw * feas_f  # [1, N]
-        sel_max_node = jnp.max(sel_feas)
-        zsum = jnp.sum(zone_oh * sel_feas, axis=1, keepdims=True)  # [Z, 1]
-        have_zones = jnp.max(feas_f * (zone_id >= 0)) > 0
-        sel_max_zone = jnp.max(zsum)
-        f_node = jnp.where(
-            sel_max_node > 0,
-            100.0 * (sel_max_node - sel_raw)
-            / jnp.maximum(sel_max_node, 1.0),
-            100.0,
-        )
-        zs_n = jnp.sum(zone_oh * zsum, axis=0, keepdims=True)  # [1, N]
-        f_zone = jnp.where(
-            sel_max_zone > 0,
-            100.0 * (sel_max_zone - zs_n)
-            / jnp.maximum(sel_max_zone, 1.0),
-            100.0,
-        )
-        blended = jnp.where(
-            have_zones & (zone_id >= 0),
-            f_node / 3.0 + (2.0 / 3.0) * f_zone,
-            f_node,
-        )
-        score = score + jnp.where(
-            selg >= 0, w_sel * jnp.floor(blended), 0.0
-        )
+        if g_sel:
+            selg = selg_ref[t]
+            sel_raw = sel_ref[pl.ds(jnp.maximum(selg, 0), 1), :].astype(
+                jnp.float32
+            )
+            sel_feas = sel_raw * feas_f  # [1, N]
+            sel_max_node = jnp.max(sel_feas)
+            zsum = jnp.sum(
+                zone_oh * sel_feas, axis=1, keepdims=True
+            )  # [Z, 1]
+            have_zones = jnp.max(feas_f * (zone_id >= 0)) > 0
+            sel_max_zone = jnp.max(zsum)
+            f_node = jnp.where(
+                sel_max_node > 0,
+                100.0 * (sel_max_node - sel_raw)
+                / jnp.maximum(sel_max_node, 1.0),
+                100.0,
+            )
+            zs_n = jnp.sum(zone_oh * zsum, axis=0, keepdims=True)  # [1, N]
+            f_zone = jnp.where(
+                sel_max_zone > 0,
+                100.0 * (sel_max_zone - zs_n)
+                / jnp.maximum(sel_max_zone, 1.0),
+                100.0,
+            )
+            blended = jnp.where(
+                have_zones & (zone_id >= 0),
+                f_node / 3.0 + (2.0 / 3.0) * f_zone,
+                f_node,
+            )
+            score = score + jnp.where(
+                selg >= 0, w_sel * jnp.floor(blended), 0.0
+            )
 
         # soft topology spread (podtopologyspread/scoring.go:199)
-        soft_w = pcol[_OFF_SOFT_W:_OFF_SOFT_W + _GT]  # [GT, 1]
-        soft_cnt = soft_ref[:, :].astype(jnp.float32)
-        soft_raw = jnp.sum(
-            jnp.where((soft_nv >= 0), soft_w * soft_cnt, 0.0),
-            axis=0, keepdims=True,
-        )  # [1, N]
-        soft_inel = ((soft_w > 0) & (soft_nv < 0)).astype(jnp.int32).max(
-            axis=0, keepdims=True
-        ) > 0
-        soft_eligible = ~soft_inel
-        has_soft = jnp.max(soft_w) > 0
-        dom = feasible & soft_eligible
-        dom_f = dom.astype(jnp.float32)
-        soft_total = jnp.sum(soft_raw * dom_f)
-        soft_min = jnp.where(
-            jnp.max(dom_f) > 0,
-            jnp.min(jnp.where(dom, soft_raw, _BIG_SOFT)),
-            _BIG_SOFT,
-        )
-        soft_diff = soft_total - soft_min
-        soft_score = jnp.where(
-            soft_diff == 0,
-            100.0,
-            jnp.where(
-                ~soft_eligible,
-                0.0,
-                jnp.floor(
-                    100.0 * (soft_total - soft_raw)
-                    / jnp.where(soft_diff == 0, 1.0, soft_diff)
+        if gt:
+            soft_w = pcol[pp_off["soft_w"]:pp_off["soft_w"] + gt]
+            soft_cnt = soft_ref[:, :].astype(jnp.float32)
+            soft_raw = jnp.sum(
+                jnp.where((soft_nv >= 0), soft_w * soft_cnt, 0.0),
+                axis=0, keepdims=True,
+            )  # [1, N]
+            soft_inel = ((soft_w > 0) & (soft_nv < 0)).astype(
+                jnp.int32
+            ).max(axis=0, keepdims=True) > 0
+            soft_eligible = ~soft_inel
+            has_soft = jnp.max(soft_w) > 0
+            dom = feasible & soft_eligible
+            dom_f = dom.astype(jnp.float32)
+            soft_total = jnp.sum(soft_raw * dom_f)
+            soft_min = jnp.where(
+                jnp.max(dom_f) > 0,
+                jnp.min(jnp.where(dom, soft_raw, _BIG_SOFT)),
+                _BIG_SOFT,
+            )
+            soft_diff = soft_total - soft_min
+            soft_score = jnp.where(
+                soft_diff == 0,
+                100.0,
+                jnp.where(
+                    ~soft_eligible,
+                    0.0,
+                    jnp.floor(
+                        100.0 * (soft_total - soft_raw)
+                        / jnp.where(soft_diff == 0, 1.0, soft_diff)
+                    ),
                 ),
-            ),
-        )
-        score = score + jnp.where(has_soft, w_soft * soft_score, 0.0)
+            )
+            score = score + jnp.where(has_soft, w_soft * soft_score, 0.0)
 
         # preferred inter-pod affinity (interpodaffinity/scoring.go)
-        ipa_w = pcol[_OFF_IPA_W:_OFF_IPA_W + _RP]
-        ipa_m = pcol[_OFF_IPA_MATCH:_OFF_IPA_MATCH + _RP]
-        row_has_val = ipa_nv >= 0
-        ipa_raw = jnp.sum(
-            jnp.where(row_has_val, ipa_ref[:, :], 0.0) * ipa_w
-            + jnp.where(row_has_val, ipaw_ref[:, :], 0.0) * ipa_m,
-            axis=0, keepdims=True,
-        )  # [1, N]
-        ipa_mn = jnp.minimum(0.0, jnp.min(ipa_raw * feas_f))
-        ipa_mx = jnp.maximum(0.0, jnp.max(ipa_raw * feas_f))
-        ipa_diff = ipa_mx - ipa_mn
-        ipa_score = jnp.where(
-            ipa_diff > 0,
-            jnp.floor(
-                100.0 * (ipa_raw - ipa_mn)
-                / jnp.maximum(ipa_diff, 1e-9) + 1e-4
-            ),
-            0.0,
-        )
-        score = score + jnp.where(ipa_live, w_ipa * ipa_score, 0.0)
+        if rp:
+            ipa_w = pcol[pp_off["ipa_w"]:pp_off["ipa_w"] + rp]
+            ipa_m = pcol[pp_off["ipa_match"]:pp_off["ipa_match"] + rp]
+            row_has_val = ipa_nv >= 0
+            ipa_raw = jnp.sum(
+                jnp.where(row_has_val, ipa_ref[:, :], 0.0) * ipa_w
+                + jnp.where(row_has_val, ipaw_ref[:, :], 0.0) * ipa_m,
+                axis=0, keepdims=True,
+            )  # [1, N]
+            ipa_mn = jnp.minimum(0.0, jnp.min(ipa_raw * feas_f))
+            ipa_mx = jnp.maximum(0.0, jnp.max(ipa_raw * feas_f))
+            ipa_diff = ipa_mx - ipa_mn
+            ipa_score = jnp.where(
+                ipa_diff > 0,
+                jnp.floor(
+                    100.0 * (ipa_raw - ipa_mn)
+                    / jnp.maximum(ipa_diff, 1e-9) + 1e-4
+                ),
+                0.0,
+            )
+            score = score + jnp.where(ipa_live, w_ipa * ipa_score, 0.0)
 
         # -- masked argmax, lowest index wins ---------------------------
         masked = jnp.where(feasible, score, -jnp.inf)
@@ -412,60 +518,73 @@ def _constrained_kernel(
             )
 
         # spread replay (value-at-choice via one-hot matmul)
-        sp_match = pcol[_OFF_SP_MATCH:_OFF_SP_MATCH + _G_SP]
-        sp_vc = _at_choice(sp_nv.astype(jnp.float32), onehot_n)  # [G, 1]
-        sp_bump = (
-            (sp_match > 0) & (sp_vc >= 0)
-        ).astype(jnp.float32) * placed_f
-        sp_node_ref[:, :] = sp_node_ref[:, :] + (
-            sp_bump * (sp_nv == sp_vc.astype(jnp.int32))
-        ).astype(jnp.int32)
-        sp_val_ref[:, :] = sp_val_ref[:, :] + (
-            sp_bump * (val_iota == sp_vc.astype(jnp.int32))
-        ).astype(jnp.int32)
+        if g_sp:
+            sp_match = pcol[pp_off["sp_match"]:pp_off["sp_match"] + g_sp]
+            sp_vc = _at_choice(sp_nv.astype(jnp.float32), onehot_n)
+            sp_bump = (
+                (sp_match > 0) & (sp_vc >= 0)
+            ).astype(jnp.float32) * placed_f
+            sp_node_ref[:, :] = sp_node_ref[:, :] + (
+                sp_bump * (sp_nv == sp_vc.astype(jnp.int32))
+            ).astype(jnp.int32)
+            sp_val_ref[:, :] = sp_val_ref[:, :] + (
+                sp_bump * (val_iota == sp_vc.astype(jnp.int32))
+            ).astype(jnp.int32)
 
         # affinity replays
-        aff_bump = pcol[_OFF_AFF_BUMP:_OFF_AFF_BUMP + _RA]
-        va = _at_choice(vals_aff.astype(jnp.float32), onehot_n)
-        a_b = aff_bump * (va >= 0) * placed_f
-        aff_node_ref[:, :] = aff_node_ref[:, :] + (
-            a_b * (vals_aff == va.astype(jnp.int32))
-        ).astype(jnp.int32)
-        aff_tot_ref[:, :] = aff_tot_ref[:, :] + a_b
+        if ra:
+            aff_bump = pcol[pp_off["aff_bump"]:pp_off["aff_bump"] + ra]
+            va = _at_choice(vals_aff.astype(jnp.float32), onehot_n)
+            a_b = aff_bump * (va >= 0) * placed_f
+            aff_node_ref[:, :] = aff_node_ref[:, :] + (
+                a_b * (vals_aff == va.astype(jnp.int32))
+            ).astype(jnp.int32)
+            aff_tot_ref[:, :] = aff_tot_ref[:, :] + a_b
 
-        anti_bump = pcol[_OFF_ANTI_BUMP:_OFF_ANTI_BUMP + _RT]
-        vt = _at_choice(vals_anti.astype(jnp.float32), onehot_n)
-        anti_ref[:, :] = anti_ref[:, :] + (
-            anti_bump * (vt >= 0) * placed_f
-            * (vals_anti == vt.astype(jnp.int32))
-        ).astype(jnp.int32)
+        if rt:
+            anti_bump = pcol[pp_off["anti_bump"]:pp_off["anti_bump"] + rt]
+            vt = _at_choice(vals_anti.astype(jnp.float32), onehot_n)
+            anti_ref[:, :] = anti_ref[:, :] + (
+                anti_bump * (vt >= 0) * placed_f
+                * (vals_anti == vt.astype(jnp.int32))
+            ).astype(jnp.int32)
 
-        exist_bump = pcol[_OFF_EXIST_BUMP:_OFF_EXIST_BUMP + _RE]
-        ve = _at_choice(vals_exist.astype(jnp.float32), onehot_n)
-        exist_ref[:, :] = exist_ref[:, :] + (
-            exist_bump * (ve >= 0) * placed_f
-            * (vals_exist == ve.astype(jnp.int32))
-        ).astype(jnp.int32)
+        if re:
+            exist_bump = (
+                pcol[pp_off["exist_bump"]:pp_off["exist_bump"] + re]
+            )
+            ve = _at_choice(vals_exist.astype(jnp.float32), onehot_n)
+            exist_ref[:, :] = exist_ref[:, :] + (
+                exist_bump * (ve >= 0) * placed_f
+                * (vals_exist == ve.astype(jnp.int32))
+            ).astype(jnp.int32)
 
         # score-family replays
-        sel_match = pcol[_OFF_SEL_MATCH:_OFF_SEL_MATCH + _G_SEL]
-        sel_ref[:, :] = sel_ref[:, :] + (
-            sel_match * placed_f * onehot.astype(jnp.float32)
-        ).astype(jnp.int32)
+        if g_sel:
+            sel_match = (
+                pcol[pp_off["sel_match"]:pp_off["sel_match"] + g_sel]
+            )
+            sel_ref[:, :] = sel_ref[:, :] + (
+                sel_match * placed_f * onehot.astype(jnp.float32)
+            ).astype(jnp.int32)
 
-        soft_match = pcol[_OFF_SOFT_MATCH:_OFF_SOFT_MATCH + _GT]
-        svc = _at_choice(soft_nv.astype(jnp.float32), onehot_n)
-        soft_ref[:, :] = soft_ref[:, :] + (
-            soft_match * (svc >= 0) * placed_f
-            * (soft_nv == svc.astype(jnp.int32))
-        ).astype(jnp.int32)
+        if gt:
+            soft_match = (
+                pcol[pp_off["soft_match"]:pp_off["soft_match"] + gt]
+            )
+            svc = _at_choice(soft_nv.astype(jnp.float32), onehot_n)
+            soft_ref[:, :] = soft_ref[:, :] + (
+                soft_match * (svc >= 0) * placed_f
+                * (soft_nv == svc.astype(jnp.int32))
+            ).astype(jnp.int32)
 
-        ipa_bump = pcol[_OFF_IPA_BUMP:_OFF_IPA_BUMP + _RP]
-        vi = _at_choice(ipa_nv.astype(jnp.float32), onehot_n)
-        vi_ok = (vi >= 0).astype(jnp.float32) * placed_f
-        same_v = (ipa_nv == vi.astype(jnp.int32)).astype(jnp.float32)
-        ipa_ref[:, :] = ipa_ref[:, :] + ipa_m * vi_ok * same_v
-        ipaw_ref[:, :] = ipaw_ref[:, :] + ipa_bump * vi_ok * same_v
+        if rp:
+            ipa_bump = pcol[pp_off["ipa_bump"]:pp_off["ipa_bump"] + rp]
+            vi = _at_choice(ipa_nv.astype(jnp.float32), onehot_n)
+            vi_ok = (vi >= 0).astype(jnp.float32) * placed_f
+            same_v = (ipa_nv == vi.astype(jnp.int32)).astype(jnp.float32)
+            ipa_ref[:, :] = ipa_ref[:, :] + ipa_m * vi_ok * same_v
+            ipaw_ref[:, :] = ipaw_ref[:, :] + ipa_bump * vi_ok * same_v
         return 0
 
     jax.lax.fori_loop(0, chunk, body, 0)
@@ -519,7 +638,155 @@ def _node_counts(counts, node_value):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("config", "interpret"))
+def constrained_vmem_bytes(
+    n: int,
+    r: int,
+    u: int,
+    s: int,
+    z: int,
+    v_sp: int,
+    caps: Caps,
+    chunk: int = 1024,
+) -> int:
+    """Estimated VMEM residency of one specialization: every node-sized
+    (and spread value-space) matrix the kernel keeps live, plus the
+    per-pod param block (double-buffered) and a temporaries margin. The
+    use_pallas gate compares this against the budget instead of the old
+    blanket node-count cap (a high-signature-diversity batch can blow
+    VMEM through U or S alone -- ADVICE r4)."""
+    rows_n = (
+        r + 1 + u          # alloc, valid, mask rows
+        + 3 * s            # direct / nodeaff / taint
+        + 2 * caps.g_sp    # sp_nv + sp_node state
+        + 2 * caps.ra      # vals_aff + aff_node state
+        + 2 * caps.rt
+        + 2 * caps.re
+        + 2 * caps.gt      # soft_nv + soft state
+        + 3 * caps.rp      # ipa_nv + ipa + ipaw states
+        + r + 2            # req + nzr states
+    )
+    if caps.g_sel:
+        rows_n += caps.g_sel + z + 1  # sel state + zone_oh + zone_id
+    bytes_n = 4 * n * rows_n
+    if caps.g_sp:
+        bytes_n += 4 * v_sp * 2 * caps.g_sp  # sp_val state + sp_vvalid
+    if caps.ra:
+        bytes_n += 4 * 128 * caps.ra  # aff_tot
+    _, pp_pad = _pp_layout(caps)
+    bytes_n += 4 * pp_pad * chunk * 2  # pp block, double-buffered
+    # temporaries: a handful of [1, N] f32 intermediates per family plus
+    # Mosaic working space
+    bytes_n += 4 * n * 24 + (1 << 20)
+    return bytes_n
+
+
+#: conservative per-core VMEM budget for the gate (v5e/v4 have ~16MB;
+#: leave headroom for Mosaic spills and the pipeline's own buffers)
+VMEM_BUDGET = 13 * (1 << 20)
+
+
+def _spec_plan(caps: Caps, shapes: dict, chunk: int):
+    """Build the pallas_call plumbing for one specialization: ordered
+    input specs, output shapes/specs, io aliases and name->position
+    maps. ``shapes`` carries the dynamic dims: r, n, u, s, z, v_sp."""
+    r, n = shapes["r"], shapes["n"]
+    smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
+    vmem = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+
+    def chunk_1d(i):
+        return (i,)
+
+    def whole(i):
+        return (0, 0)
+
+    def whole_1d(i):
+        return (0,)
+
+    in_specs = []
+    iidx = {}
+
+    def add_in(name, spec):
+        iidx[name] = len(in_specs)
+        in_specs.append(spec)
+
+    add_in("midx", smem((chunk,), chunk_1d))
+    add_in("podreq", smem((chunk * r,), chunk_1d))
+    add_in("podnzr", smem((chunk * 2,), chunk_1d))
+    add_in("active", smem((chunk,), chunk_1d))
+    add_in("sig", smem((chunk,), chunk_1d))
+    if caps.g_sel:
+        add_in("selg", smem((chunk,), chunk_1d))
+    if caps.ra:
+        add_in("selfm", smem((chunk,), chunk_1d))
+    add_in("flags", smem((8,), whole_1d))
+    add_in("alloc", vmem((r, n), whole))
+    add_in("valid", vmem((1, n), whole))
+    add_in("rows", vmem((shapes["u"], n), whole))
+    _, pp_pad = _pp_layout(caps)
+    add_in("pp", vmem((pp_pad, chunk), lambda i: (0, i)))
+    if caps.g_sp:
+        add_in("sp_nv", vmem((caps.g_sp, n), whole))
+        add_in("sp_vvalid", vmem((caps.g_sp, shapes["v_sp"]), whole))
+    if caps.ra:
+        add_in("vals_aff", vmem((caps.ra, n), whole))
+    if caps.rt:
+        add_in("vals_anti", vmem((caps.rt, n), whole))
+    if caps.re:
+        add_in("vals_exist", vmem((caps.re, n), whole))
+    add_in("direct", vmem((shapes["s"], n), whole))
+    add_in("nodeaff", vmem((shapes["s"], n), whole))
+    add_in("taint", vmem((shapes["s"], n), whole))
+    if caps.g_sel:
+        add_in("zone_oh", vmem((shapes["z"], n), whole))
+        add_in("zone_id", vmem((1, n), whole))
+    if caps.gt:
+        add_in("soft_nv", vmem((caps.gt, n), whole))
+    if caps.rp:
+        add_in("ipa_nv", vmem((caps.rp, n), whole))
+
+    # aliased state inputs (order mirrors the outputs after asg)
+    out_shapes = [jax.ShapeDtypeStruct((chunk * (shapes["grid"]),), jnp.int32)]
+    out_specs = [smem((chunk,), chunk_1d)]
+    oidx = {"asg": 0}
+    aliases = {}
+
+    def add_state(name, shape, dtype):
+        iidx[name + "0"] = len(in_specs)
+        in_specs.append(vmem(shape, whole))
+        oidx[name] = len(out_shapes)
+        out_shapes.append(jax.ShapeDtypeStruct(shape, dtype))
+        out_specs.append(vmem(shape, whole))
+
+    add_state("req", (r, n), jnp.int32)
+    add_state("nzr", (2, n), jnp.int32)
+    if caps.g_sp:
+        add_state("sp_node", (caps.g_sp, n), jnp.int32)
+        add_state("sp_val", (caps.g_sp, shapes["v_sp"]), jnp.int32)
+    if caps.ra:
+        add_state("aff_node", (caps.ra, n), jnp.int32)
+        add_state("aff_tot", (caps.ra, 128), jnp.float32)
+    if caps.rt:
+        add_state("anti", (caps.rt, n), jnp.int32)
+    if caps.re:
+        add_state("exist", (caps.re, n), jnp.int32)
+    if caps.g_sel:
+        add_state("sel", (caps.g_sel, n), jnp.int32)
+    if caps.gt:
+        add_state("soft", (caps.gt, n), jnp.int32)
+    if caps.rp:
+        add_state("ipa", (caps.rp, n), jnp.float32)
+        add_state("ipaw", (caps.rp, n), jnp.float32)
+
+    for name, out_pos in oidx.items():
+        key = name + "0"
+        if key in iidx:
+            aliases[iidx[key]] = out_pos
+    return in_specs, out_shapes, out_specs, iidx, oidx, aliases
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "interpret", "caps")
+)
 def pallas_constrained_solve(
     allocatable: jnp.ndarray,  # [N, R] int32
     requested: jnp.ndarray,  # [N, R] int32
@@ -535,9 +802,13 @@ def pallas_constrained_solve(
     scoring: Tuple[jnp.ndarray, ...],
     config: GreedyConfig = GreedyConfig(),
     interpret: bool = False,
+    caps: Optional[Caps] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Drop-in for ops/assignment.greedy_assign_constrained, fused into
-    one Pallas kernel. Same family tuples, same return shape."""
+    one Pallas kernel. Same family tuples, same return shape. ``caps``
+    selects the family specialization (None = the packer maximums)."""
+    if caps is None:
+        caps = FULL_CAPS
     (sp_counts0, sp_value_valid, sp_node_value,
      sp_pod_groups, sp_pod_max_skew, sp_pod_self, sp_pod_match) = spread
     (af_node_value, af_counts_aff0, af_row_key_aff, af_pod_aff_rows,
@@ -564,51 +835,43 @@ def pallas_constrained_solve(
     assert sc_sel_counts0.shape[0] == _G_SEL
 
     # -- prologue (XLA): node-space initial counts + dense pod params ---
-    vals_aff = row_node_values(af_node_value, af_row_key_aff)
-    vals_anti = row_node_values(af_node_value, af_row_key_anti)
-    vals_exist = row_node_values(af_node_value, af_row_key_exist)
+    g_sp, ra, rt, re, gt, rp, g_sel = caps
+    pp_off, pp_pad = _pp_layout(caps)
+    pp = jnp.zeros((pp_pad, b), dtype=jnp.float32)
 
-    sp_node0 = _node_counts(sp_counts0, sp_node_value)
-    aff_node0 = _node_counts(af_counts_aff0, vals_aff)
-    anti_node0 = _node_counts(af_counts_anti0, vals_anti)
-    exist_node0 = _node_counts(af_counts_exist0, vals_exist)
-    soft_node0 = _node_counts(sc_soft_counts0, sc_soft_node_value)
-    ipa_node0 = _node_counts(sc_ipa_counts0, sc_ipa_node_value)
-    ipaw_node0 = _node_counts(sc_ipa_wcounts0, sc_ipa_node_value)
-    aff_tot0 = jnp.broadcast_to(
-        af_counts_aff0.sum(axis=1, keepdims=True).astype(jnp.float32),
-        (_RA, 128),
-    )
-
-    pp = jnp.zeros((_PP_PAD, b), dtype=jnp.float32)
-
-    def put(off, mat):
-        return pp.at[off:off + mat.shape[1], :].set(
-            mat.T.astype(jnp.float32)
+    def put(name, mat, cap):
+        if not cap:
+            return None
+        off = pp_off[name]
+        nonlocal pp
+        pp = pp.at[off:off + cap, :].set(
+            mat[:, :cap].T.astype(jnp.float32)
+            if mat.ndim == 2 and mat.shape[1] >= cap
+            else mat.T.astype(jnp.float32)
         )
 
-    pp = put(_OFF_SP_LIMIT, _dense_limit(
-        sp_pod_groups, sp_pod_max_skew, sp_pod_self, _G_SP
-    ))
-    pp = put(_OFF_SP_MATCH, sp_pod_match)
-    pp = put(_OFF_AFF_ACT, _dense_act(af_pod_aff_rows, _RA))
-    pp = put(_OFF_AFF_BUMP, af_pod_bump_aff)
-    pp = put(_OFF_ANTI_ACT, _dense_act(af_pod_anti_rows, _RT))
-    pp = put(_OFF_ANTI_BUMP, af_pod_bump_anti)
-    pp = put(_OFF_EXIST_MATCH, af_pod_exist_match)
-    pp = put(_OFF_EXIST_BUMP, af_pod_bump_exist)
-    pp = put(_OFF_SOFT_W, _dense_weight(sc_pod_soft_groups, _GT))
-    pp = put(_OFF_SOFT_MATCH, sc_pod_soft_match)
-    pp = put(_OFF_IPA_W, sc_pod_ipa_weight)
-    pp = put(_OFF_IPA_MATCH, sc_pod_ipa_match)
-    pp = put(_OFF_IPA_BUMP, sc_pod_ipa_bump)
-    pp = put(_OFF_SEL_MATCH, sc_pod_sel_match)
+    put("sp_limit", _dense_limit(
+        sp_pod_groups, sp_pod_max_skew, sp_pod_self, g_sp or 1
+    ), g_sp)
+    put("sp_match", sp_pod_match, g_sp)
+    put("aff_act", _dense_act(af_pod_aff_rows, ra or 1), ra)
+    put("aff_bump", af_pod_bump_aff, ra)
+    put("anti_act", _dense_act(af_pod_anti_rows, rt or 1), rt)
+    put("anti_bump", af_pod_bump_anti, rt)
+    put("exist_match", af_pod_exist_match, re)
+    put("exist_bump", af_pod_bump_exist, re)
+    put("soft_w", _dense_weight(sc_pod_soft_groups, gt or 1), gt)
+    put("soft_match", sc_pod_soft_match, gt)
+    put("ipa_w", sc_pod_ipa_weight, rp)
+    put("ipa_match", sc_pod_ipa_match, rp)
+    put("ipa_bump", sc_pod_ipa_bump, rp)
+    put("sel_match", sc_pod_sel_match, g_sel)
 
-    ipa_live = (sc_ipa_node_value >= 0).any()
+    ipa_live = (sc_ipa_node_value[:rp or 1] >= 0).any() if rp else False
     flags = jnp.concatenate(
         [
             sc_weights[:5].astype(jnp.int32),
-            ipa_live.astype(jnp.int32)[None],
+            jnp.asarray(ipa_live, dtype=jnp.int32)[None],
             jnp.zeros((2,), dtype=jnp.int32),
         ]
     )
@@ -620,147 +883,128 @@ def pallas_constrained_solve(
     chunk = min(b, 1024)
     assert b % chunk == 0, "batch must be a multiple of the pod chunk"
     grid = (b // chunk,)
+    kernel_caps = caps
+
+    v_sp = sp_counts0.shape[1]
+    shapes = {
+        "r": r, "n": n, "u": mask_rows.shape[0], "s": sc_direct.shape[0],
+        "z": sc_zone_onehot.shape[1], "v_sp": v_sp,
+        "grid": grid[0],  # asg SMEM out_shape spans the full batch
+    }
+    in_specs, out_shapes, out_specs, iidx, oidx, aliases = _spec_plan(
+        kernel_caps, shapes, chunk
+    )
+
     kernel = functools.partial(
         _constrained_kernel,
         chunk=chunk,
         r=r,
+        caps=kernel_caps,
+        iidx=tuple(sorted(iidx.items())),
+        oidx=tuple(sorted(oidx.items())),
+        nin=len(in_specs),
         w_least=config.least_allocated_weight,
         w_balanced=config.balanced_allocation_weight,
         w_most=config.most_allocated_weight,
     )
 
-    def chunk_1d(i):
-        return (i,)
+    # -- assemble operands in iidx order --------------------------------
+    operands = {}
+    operands["midx"] = mask_index.astype(jnp.int32)
+    operands["podreq"] = pod_requests.astype(jnp.int32).reshape(-1)
+    operands["podnzr"] = pod_nzr.astype(jnp.int32).reshape(-1)
+    operands["active"] = active.astype(jnp.int32)
+    operands["sig"] = sc_pod_sig.astype(jnp.int32)
+    if g_sel:
+        operands["selg"] = sc_pod_sel_group.astype(jnp.int32)
+    if ra:
+        operands["selfm"] = af_pod_self_match.astype(jnp.int32)
+    operands["flags"] = flags
+    operands["alloc"] = allocatable.T
+    operands["valid"] = valid.astype(jnp.int32)[None, :]
+    operands["rows"] = mask_rows.astype(jnp.int32)
+    operands["pp"] = pp
+    if g_sp:
+        operands["sp_nv"] = sp_node_value[:g_sp]
+        operands["sp_vvalid"] = sp_value_valid[:g_sp].astype(jnp.int32)
+    if ra:
+        operands["vals_aff"] = row_node_values(
+            af_node_value, af_row_key_aff[:ra]
+        )
+    if rt:
+        operands["vals_anti"] = row_node_values(
+            af_node_value, af_row_key_anti[:rt]
+        )
+    if re:
+        operands["vals_exist"] = row_node_values(
+            af_node_value, af_row_key_exist[:re]
+        )
+    operands["direct"] = sc_direct.astype(jnp.float32)
+    operands["nodeaff"] = sc_nodeaff.astype(jnp.float32)
+    operands["taint"] = sc_taint.astype(jnp.float32)
+    if g_sel:
+        operands["zone_oh"] = jnp.transpose(sc_zone_onehot).astype(
+            jnp.float32
+        )
+        operands["zone_id"] = sc_zone_id.astype(jnp.int32)[None, :]
+    if gt:
+        operands["soft_nv"] = sc_soft_node_value[:gt]
+    if rp:
+        operands["ipa_nv"] = sc_ipa_node_value[:rp]
+    # aliased initial states
+    operands["req0"] = requested.T
+    operands["nzr0"] = nzr.T
+    if g_sp:
+        operands["sp_node0"] = _node_counts(
+            sp_counts0[:g_sp], sp_node_value[:g_sp]
+        )
+        operands["sp_val0"] = sp_counts0[:g_sp]
+    if ra:
+        operands["aff_node0"] = _node_counts(
+            af_counts_aff0[:ra], operands["vals_aff"]
+        )
+        operands["aff_tot0"] = jnp.broadcast_to(
+            af_counts_aff0[:ra].sum(axis=1, keepdims=True).astype(
+                jnp.float32
+            ),
+            (ra, 128),
+        )
+    if rt:
+        operands["anti0"] = _node_counts(
+            af_counts_anti0[:rt], operands["vals_anti"]
+        )
+    if re:
+        operands["exist0"] = _node_counts(
+            af_counts_exist0[:re], operands["vals_exist"]
+        )
+    if g_sel:
+        operands["sel0"] = sc_sel_counts0[:g_sel]
+    if gt:
+        operands["soft0"] = _node_counts(
+            sc_soft_counts0[:gt], sc_soft_node_value[:gt]
+        )
+    if rp:
+        operands["ipa0"] = _node_counts(
+            sc_ipa_counts0[:rp], sc_ipa_node_value[:rp]
+        )
+        operands["ipaw0"] = _node_counts(
+            sc_ipa_wcounts0[:rp], sc_ipa_node_value[:rp]
+        )
 
-    def whole(i):
-        return (0, 0)
-
-    def whole_1d(i):
-        return (0,)
-
-    smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
-    vmem = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
-    v_sp = sp_counts0.shape[1]
-
-    out_shapes = (
-        jax.ShapeDtypeStruct((b,), jnp.int32),            # asg
-        jax.ShapeDtypeStruct((r, n), jnp.int32),          # req
-        jax.ShapeDtypeStruct((2, n), jnp.int32),          # nzr
-        jax.ShapeDtypeStruct((_G_SP, n), jnp.int32),      # sp node
-        jax.ShapeDtypeStruct((_G_SP, v_sp), jnp.int32),   # sp val
-        jax.ShapeDtypeStruct((_RA, n), jnp.int32),        # aff node
-        jax.ShapeDtypeStruct((_RA, 128), jnp.float32),    # aff tot
-        jax.ShapeDtypeStruct((_RT, n), jnp.int32),        # anti
-        jax.ShapeDtypeStruct((_RE, n), jnp.int32),        # exist
-        jax.ShapeDtypeStruct((_G_SEL, n), jnp.int32),     # sel
-        jax.ShapeDtypeStruct((_GT, n), jnp.int32),        # soft
-        jax.ShapeDtypeStruct((_RP, n), jnp.float32),      # ipa
-        jax.ShapeDtypeStruct((_RP, n), jnp.float32),      # ipaw
-    )
-    # the 12 aliased state inputs follow the 8 SMEM + 16 static VMEM
-    # inputs; they map to outputs 1..12 (output 0 is the assignment)
-    state_in_start = 24
-    io_aliases = {state_in_start + k: 1 + k for k in range(12)}
+    args = [None] * len(iidx)
+    for name, pos in iidx.items():
+        args[pos] = operands[name]
 
     outs = pl.pallas_call(
         kernel,
         grid=grid,
-        out_shape=out_shapes,
-        in_specs=[
-            smem((chunk,), chunk_1d),              # midx
-            smem((chunk * r,), chunk_1d),          # podreq
-            smem((chunk * 2,), chunk_1d),          # podnzr
-            smem((chunk,), chunk_1d),              # active
-            smem((chunk,), chunk_1d),              # sig
-            smem((chunk,), chunk_1d),              # selg
-            smem((chunk,), chunk_1d),              # selfm
-            smem((8,), whole_1d),                  # flags
-            vmem((r, n), whole),                   # alloc
-            vmem((1, n), whole),                   # valid
-            vmem(mask_rows.shape, whole),          # rows
-            vmem((_PP_PAD, chunk), lambda i: (0, i)),  # pp
-            vmem((_G_SP, n), whole),               # sp_nv
-            vmem((_G_SP, v_sp), whole),            # sp_vvalid
-            vmem((_RA, n), whole),                 # vals_aff
-            vmem((_RT, n), whole),                 # vals_anti
-            vmem((_RE, n), whole),                 # vals_exist
-            vmem(sc_direct.shape, whole),          # direct
-            vmem(sc_nodeaff.shape, whole),         # nodeaff
-            vmem(sc_taint.shape, whole),           # taint
-            vmem((sc_zone_onehot.shape[1], n), whole),  # zone_oh (Z, N)
-            vmem((1, n), whole),                   # zone_id
-            vmem((_GT, n), whole),                 # soft_nv
-            vmem((_RP, n), whole),                 # ipa_nv
-            # aliased state inputs (24..35)
-            vmem((r, n), whole),                   # req0
-            vmem((2, n), whole),                   # nzr0
-            vmem((_G_SP, n), whole),               # sp node0
-            vmem((_G_SP, v_sp), whole),            # sp val0
-            vmem((_RA, n), whole),                 # aff node0
-            vmem((_RA, 128), whole),               # aff tot0
-            vmem((_RT, n), whole),                 # anti0
-            vmem((_RE, n), whole),                 # exist0
-            vmem((_G_SEL, n), whole),              # sel0
-            vmem((_GT, n), whole),                 # soft0
-            vmem((_RP, n), whole),                 # ipa0
-            vmem((_RP, n), whole),                 # ipaw0
-        ],
-        out_specs=(
-            smem((chunk,), chunk_1d),
-            vmem((r, n), whole),
-            vmem((2, n), whole),
-            vmem((_G_SP, n), whole),
-            vmem((_G_SP, v_sp), whole),
-            vmem((_RA, n), whole),
-            vmem((_RA, 128), whole),
-            vmem((_RT, n), whole),
-            vmem((_RE, n), whole),
-            vmem((_G_SEL, n), whole),
-            vmem((_GT, n), whole),
-            vmem((_RP, n), whole),
-            vmem((_RP, n), whole),
-        ),
-        input_output_aliases=io_aliases,
+        out_shape=tuple(out_shapes),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        input_output_aliases=aliases,
         interpret=interpret,
-    )(
-        mask_index.astype(jnp.int32),
-        pod_requests.astype(jnp.int32).reshape(-1),
-        pod_nzr.astype(jnp.int32).reshape(-1),
-        active.astype(jnp.int32),
-        sc_pod_sig.astype(jnp.int32),
-        sc_pod_sel_group.astype(jnp.int32),
-        af_pod_self_match.astype(jnp.int32),
-        flags,
-        allocatable.T,
-        valid.astype(jnp.int32)[None, :],
-        mask_rows.astype(jnp.int32),
-        pp,
-        sp_node_value,
-        sp_value_valid.astype(jnp.int32),
-        vals_aff,
-        vals_anti,
-        vals_exist,
-        sc_direct.astype(jnp.float32),
-        sc_nodeaff.astype(jnp.float32),
-        sc_taint.astype(jnp.float32),
-        jnp.transpose(sc_zone_onehot).astype(jnp.float32),
-        sc_zone_id.astype(jnp.int32)[None, :],
-        sc_soft_node_value,
-        sc_ipa_node_value,
-        requested.T,
-        nzr.T,
-        sp_node0,
-        sp_counts0,
-        aff_node0,
-        aff_tot0,
-        anti_node0,
-        exist_node0,
-        sc_sel_counts0,
-        soft_node0,
-        ipa_node0,
-        ipaw_node0,
-    )
-    asg = outs[0]
-    req_out_t = outs[1]
-    nzr_out_t = outs[2]
+    )(*args)
+    asg = outs[oidx["asg"]]
+    req_out_t = outs[oidx["req"]]
+    nzr_out_t = outs[oidx["nzr"]]
     return asg, req_out_t.T, nzr_out_t.T

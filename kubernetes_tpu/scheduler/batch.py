@@ -1574,19 +1574,25 @@ class BatchScheduler(Scheduler):
                 config=self.solver_config, mode="constrained",
             )
             jax.block_until_ready(c_steady)
-            # single-family layouts (absent families ride as ZeroPiece
-            # device constants): the steady-carry variants the measured
-            # phases of spread / affinity / score-only workloads hit
+            # family-combo layouts (absent families ride as ConstPiece
+            # device constants): the kernel specializes per PRESENT
+            # family combo (pallas_constrained.live_caps), so warm the
+            # steady-carry variant of every combo a measured phase can
+            # hit -- 2^3 - 1, each a distinct Caps and pallas compile
             from kubernetes_tpu.ops.assignment import ConstPiece
 
             fam_groups = {"sp": noops[0], "af": noops[1], "sc": noops[2]}
-            for live in ("sp", "af", "sc"):
+            combos = (
+                ("sp",), ("af",), ("sc",),
+                ("sp", "af"), ("sp", "sc"), ("af", "sc"),
+            )  # the triple is already warmed by c_cold/refresh/steady
+            for live in combos:
                 fam_one = []
                 for prefix, arrs in fam_groups.items():
                     for i, a in enumerate(arrs):
                         fam_one.append(
                             (f"{prefix}{i}", np.asarray(a))
-                            if prefix == live
+                            if prefix in live
                             else (
                                 f"{prefix}{i}",
                                 ConstPiece.from_uniform(a),

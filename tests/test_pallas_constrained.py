@@ -217,3 +217,53 @@ def test_noop_families_match_basic_path():
     np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
     np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
     np.testing.assert_array_equal(np.asarray(z1), np.asarray(z2))
+
+
+def _derive_caps(sp_t, af_t, sc_t):
+    """The caps the solver would pick for this batch (all families
+    treated as present -- _packed_problem always packs real batches)."""
+    from kubernetes_tpu.ops.assignment import caps_for_families
+
+    return caps_for_families(sp_t, af_t, sc_t, True, True, True)
+
+
+@pytest.mark.parametrize("seed", [0, 11, 42])
+def test_constrained_kernel_reduced_caps_matches_xla(seed):
+    """The family-specialized kernel (reduced Caps, the VMEM-cap
+    breaker) must agree with the XLA scan exactly like the full-caps
+    kernel does."""
+    common, sp_t, af_t, sc_t = _packed_problem(seed)
+    caps = _derive_caps(sp_t, af_t, sc_t)
+    a1, r1, z1 = greedy_assign_constrained(
+        *common, sp_t, af_t, sc_t, config=GreedyConfig()
+    )
+    a2, r2, z2 = pallas_constrained_solve(
+        *common, sp_t, af_t, sc_t, config=GreedyConfig(),
+        interpret=True, caps=caps,
+    )
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+    np.testing.assert_array_equal(np.asarray(z1), np.asarray(z2))
+
+
+def test_constrained_kernel_zero_caps_matches_basic():
+    """All families absent -> Caps all zero: the specialized kernel
+    degenerates to the plain greedy scan."""
+    from kubernetes_tpu.ops.pallas_constrained import Caps
+
+    common, _, _, _ = _packed_problem(7)
+    padded = common[4].shape[0]
+    n_cap = common[0].shape[0]
+    sp_t = tuple(noop_spread_tensors(padded, n_cap))
+    af_t = tuple(noop_affinity_tensors(padded, n_cap))
+    sc_t = tuple(noop_score_tensors(padded, n_cap))
+    a1, r1, z1 = greedy_assign_constrained(
+        *common, sp_t, af_t, sc_t, config=GreedyConfig()
+    )
+    a2, r2, z2 = pallas_constrained_solve(
+        *common, sp_t, af_t, sc_t, config=GreedyConfig(),
+        interpret=True, caps=Caps(0, 0, 0, 0, 0, 0, 0),
+    )
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+    np.testing.assert_array_equal(np.asarray(z1), np.asarray(z2))
