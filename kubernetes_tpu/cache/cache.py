@@ -119,6 +119,12 @@ class SchedulerCache:
         with self._lock:
             return self._assumed_pods.get(pod.metadata.uid, False)
 
+    def has_pod_uid(self, uid: str) -> bool:
+        """Membership probe (preemption uses it to detect when victim
+        deletions have propagated from the watch into the cache)."""
+        with self._lock:
+            return uid in self._pod_states
+
     # -- confirmed pod events (informer-driven) -----------------------------
 
     def _add_pod_locked(self, pod: Pod, strict: bool) -> None:

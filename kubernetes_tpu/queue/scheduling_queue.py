@@ -166,17 +166,35 @@ class PriorityQueue:
                 self._delete_locked(pod)
 
     def add_unschedulable_if_not_present(
-        self, pi: PodInfo, pod_scheduling_cycle: int
+        self, pi: PodInfo, pod_scheduling_cycle: int,
+        skip_backoff: bool = False,
     ) -> None:
         """Failed pod back into the queue (reference :290). A move request
         during this pod's scheduling attempt sends it to backoff instead of
-        unschedulableQ -- the lost-wakeup guard."""
+        unschedulableQ -- the lost-wakeup guard.
+
+        ``skip_backoff`` requeues straight to the activeQ: the batched
+        preemption path uses it for pods whose blocking victims were
+        evicted in the same wave (see Scheduler.record_scheduling_failure)."""
         with self._cond:
             key = _info_key(pi)
             if key in self.unschedulable_q:
                 raise KeyError(f"pod {key} is already in the unschedulable queue")
             if key in self.active_q or key in self.pod_backoff_q:
                 raise KeyError(f"pod {key} is already queued")
+            if skip_backoff:
+                # keep the original enqueue timestamp: the nominee must
+                # sort BEFORE later burst arrivals so it reclaims the
+                # capacity its own wave freed (the batch analogue of
+                # addNominatedPods shielding nominees from other pods,
+                # generic_scheduler.go:535). Do NOT touch nominated_pods
+                # here: the wave just registered the nomination via
+                # update_nominated_pod_for_node, and the pod object's
+                # STATUS write is deferred -- add(pod, "") would fall
+                # back to the empty status and delete the entry
+                self.active_q.add(pi)
+                self._cond.notify()
+                return
             pi.timestamp = self._now()
             if self.move_request_cycle >= pod_scheduling_cycle:
                 self.pod_backoff_q.add(pi)
@@ -495,6 +513,13 @@ class PriorityQueue:
             return self.nominated_pods.pods_for_node(node_name)
 
     # -- introspection ------------------------------------------------------
+
+    def active_count(self) -> int:
+        """Pods ready in the activeQ right now (cheap peek; the batch
+        scheduler's preemption deferral uses it to detect a burst still
+        streaming in)."""
+        with self._cond:
+            return len(self.active_q)
 
     def pending_pods(self) -> List[Pod]:
         with self._lock:

@@ -220,6 +220,13 @@ class BatchScheduler(Scheduler):
         self._pending_q: "collections.deque" = collections.deque()
         self._pending_cv = threading.Condition()
         self._committer: Optional[threading.Thread] = None
+        # failures parked across in-flight batches for one combined
+        # preemption wave (touched only by the committing thread: the
+        # committer loop, or the dispatcher on the synchronous paths,
+        # which drain the pipeline first)
+        self._deferred_preempt: List = []
+        self._deferred_since = 0.0
+        self._prewarm_next_commit = False
         self._committer_stop = False
         # collect-at-idle gc policy, engaged only by the production run
         # loop (tests driving schedule_batch directly keep gc untouched)
@@ -247,6 +254,11 @@ class BatchScheduler(Scheduler):
         if not batch_infos:
             # idle: finish whatever is still in flight
             self._drain_pending()
+            if self._deferred_preempt:
+                # safety net: a mixed burst whose tail took the fallback
+                # path produces no further batch commits to trigger the
+                # deferred wave
+                self._flush_deferred_preemptions()
             if guard is not None:
                 guard.idle()
             return 0
@@ -458,6 +470,7 @@ class BatchScheduler(Scheduler):
                     return
                 p = self._pending_q[0]
             try:
+                p["committing"] = True
                 self._complete_solve(p)
             except Exception:
                 logger.exception("batch commit crashed")
@@ -475,6 +488,11 @@ class BatchScheduler(Scheduler):
         dropped since the batch's true placements are unknown."""
         with self._shadow_lock:
             self._dev.invalidate_carry()
+        try:
+            if self._deferred_preempt:
+                self._flush_deferred_preemptions()
+        except Exception:
+            logger.exception("flushing deferred preemptions on recovery")
         prof = self.profiles.get(
             p["solver_infos"][0].pod.spec.scheduler_name
         )
@@ -583,14 +601,31 @@ class BatchScheduler(Scheduler):
             nominated_by_node = self.queue.all_nominated_pods_by_node()
             return True
 
+        nominee_uids = (
+            {
+                p.metadata.uid
+                for noms in nominated_by_node.values()
+                for p in noms
+            }
+            if nominated_by_node else set()
+        )
         drained(
             has_hard_spread or has_affinity or score_dynamic
-            or bool(nominated_by_node)
             # an in-flight batch carrying required anti-affinity or
             # scoring-relevant terms imposes symmetric constraints this
             # batch can only see once its placements are committed
             or self._pending_has_required_anti()
             or self._pending_has_scoring_terms()
+            # a batch RETRYING preemption nominees must see the fully
+            # committed post-eviction state, or in-flight placements
+            # race it onto the freed capacity and cascade re-preemption
+            # (the old answer -- drain while ANY nomination lived --
+            # serialized every post-preemption dispatch; this drains
+            # only the nominees' own retry batches)
+            or any(
+                pi.pod.metadata.uid in nominee_uids
+                for pi in solver_infos
+            )
         )
 
         snapshot = self.algorithm.snapshot
@@ -665,10 +700,32 @@ class BatchScheduler(Scheduler):
         # Nominated-pod overlay: reserve capacity for preemption nominees
         # (the batch analogue of _add_nominated_pods' virtual add,
         # generic_scheduler.go:535). Conservatively reserves for ALL
-        # nominees regardless of relative priority.
+        # nominees EXCEPT pods already being placed: this batch's own
+        # members and pods inside in-flight batches (their placement
+        # rides the device carry; overlaying them too would double-count
+        # and spuriously starve nodes -- the old answer was a full
+        # pipeline drain per dispatch while ANY nomination lived, which
+        # serialized the dispatcher against the committer for the whole
+        # post-preemption burst).
         node_requested, node_nzr = nt.requested, nt.non_zero_requested
+        # skip the overlay for pods being placed RIGHT NOW: this batch's
+        # members and pods inside dispatched-but-not-yet-committing
+        # batches (their placement rides the device carry; overlaying
+        # them too over-reserves their nodes and cascades spurious
+        # preemption). The mid-COMMIT head batch is NOT excluded: its
+        # failures are being requeued with live nominations by the
+        # deferred wave at this very moment, and their reservations
+        # must stand.
         batch_uids = {pi.pod.metadata.uid for pi in solver_infos}
-        overlaid = False
+        with self._pending_cv:
+            for pend in self._pending_q:
+                if not pend.get("committing"):
+                    batch_uids.update(
+                        pi.pod.metadata.uid
+                        for pi in pend["solver_infos"]
+                    )
+        overlay_pods = []
+        overlay_rows = []
         for node_name, nominated in nominated_by_node.items():
             if node_name not in nt.names:
                 continue
@@ -676,13 +733,20 @@ class BatchScheduler(Scheduler):
             for npod in nominated:
                 if npod.metadata.uid in batch_uids:
                     continue
-                if not overlaid:
-                    node_requested = node_requested.copy()
-                    node_nzr = node_nzr.copy()
-                    overlaid = True
-                nbatch = pack_pod_batch([npod], nt.dims)
-                node_requested[j] += nbatch.requests[0]
-                node_nzr[j] += nbatch.non_zero_requests[0]
+                overlay_pods.append(npod)
+                overlay_rows.append(j)
+        overlaid = bool(overlay_pods)
+        if overlaid:
+            node_requested = node_requested.copy()
+            node_nzr = node_nzr.copy()
+            nbatch = pack_pod_batch(overlay_pods, nt.dims)
+            np.add.at(
+                node_requested, np.asarray(overlay_rows), nbatch.requests
+            )
+            np.add.at(
+                node_nzr, np.asarray(overlay_rows),
+                nbatch.non_zero_requests,
+            )
 
         b = batch.size
         # fixed solve shape: every batch pads to max_batch so the solver
@@ -764,6 +828,19 @@ class BatchScheduler(Scheduler):
                 return None
 
         solve_timer = metrics.SinceTimer(metrics.batch_solve_duration)
+
+        # preemption prewarm: when the batch's most demanding request
+        # fits on NO node right now, failures (and a preemption wave)
+        # are coming -- build + upload the victim pack on a helper
+        # thread WHILE the solve runs, instead of paying the ~0.25s
+        # pack + ~5MB upload inside the wave
+        if self.preemptor is not None and b:
+            free_nodes = nt.allocatable - node_requested  # [N, R]
+            req_max = req[:b].max(axis=0)
+            if not (
+                (free_nodes >= req_max).all(axis=1) & nt.valid
+            ).any():
+                self.preemptor.prewarm_pack_async()
 
         # -- device-state reuse (see _DeviceNodeState) ----------------------
         ds = self._dev
@@ -1057,6 +1134,16 @@ class BatchScheduler(Scheduler):
                 mask_info=(p.get("mask_rows"), p.get("mask_index_solved")),
                 gang_failed_uids=p.get("gang_failed_uids"),
             )
+        if (
+            self._prewarm_next_commit
+            and not self._deferred_preempt
+            and self.preemptor is not None
+        ):
+            # the wave's preemptors just bound: refresh the victim pack
+            # in the background so the next contention burst finds it
+            # (and its device upload) warm
+            self._prewarm_next_commit = False
+            self.preemptor.prewarm_pack_async()
 
     # -- batched commit ------------------------------------------------------
 
@@ -1291,18 +1378,35 @@ class BatchScheduler(Scheduler):
             else:
                 bulk.append((prof, state, pi, assumed, host))
         if failed_group:
-            try:
-                nominated = self.preemptor.preempt_batch(
-                    prof, [(pi.pod, fe) for pi, fe in failed_group]
-                )
-            except Exception:
-                logger.exception("batched device preemption failed")
-                nominated = [""] * len(failed_group)
-            for (pi, fe), node in zip(failed_group, nominated):
-                self.record_scheduling_failure(
-                    prof, pi, str(fe), "Unschedulable", node,
-                    pod_scheduling_cycle,
-                )
+            # a burst that overflows the cluster fails across SEVERAL
+            # in-flight batches; preempting per batch pays the wave's
+            # fixed costs (state pack, result round trip) repeatedly and
+            # fragments the nomination replay. While more solver batches
+            # are queued behind this one (FIFO committer), park the
+            # failures; the LAST in-flight batch preempts the whole
+            # accumulated group in one device wave.
+            if not self._deferred_preempt:
+                self._deferred_since = time.monotonic()
+            self._deferred_preempt.extend(
+                (prof, pi, fe, pod_scheduling_cycle)
+                for pi, fe in failed_group
+            )
+        if self._deferred_preempt:
+            with self._pending_cv:
+                more_inflight = len(self._pending_q) > 1
+            # the burst is still streaming when the activeQ holds more
+            # pods or batches are in flight; hold the wave for them --
+            # bounded by age and size so a trickle of unschedulable
+            # pods cannot starve preemption
+            burst_live = (
+                more_inflight or self.queue.active_count() > 0
+            )
+            flush_anyway = (
+                len(self._deferred_preempt) >= self.max_batch
+                or time.monotonic() - self._deferred_since > 0.3
+            )
+            if not burst_live or flush_anyway:
+                self._flush_deferred_preemptions()
         if bulk:
             with self._inflight_lock:
                 self._inflight_binds += 1
@@ -1315,6 +1419,71 @@ class BatchScheduler(Scheduler):
                 prof_d, state_d, pi_d, assumed_d, host_d,
                 pod_scheduling_cycle,
             )
+
+    def _flush_deferred_preemptions(self) -> None:
+        """Run one preemption wave for every parked failure, grouped by
+        profile (preempt_batch is profile-scoped), then requeue the pods
+        with their nominations."""
+        parked = self._deferred_preempt
+        self._deferred_preempt = []
+        # preempt_batch (and the host-side nomination fold inside the
+        # device wave) require priority-DESC order; parked failures from
+        # several batches can interleave priorities
+        parked.sort(key=lambda t: (-t[1].pod.spec.priority, t[1].timestamp))
+        by_prof: dict = {}
+        for prof, pi, fe, cycle in parked:
+            by_prof.setdefault(id(prof), (prof, []))[1].append(
+                (pi, fe, cycle)
+            )
+        for prof, items in by_prof.values():
+            victim_uids: Optional[List[str]] = []
+            try:
+                with timeline.span("preempt_wave"):
+                    nominated, victim_uids = self.preemptor.preempt_batch(
+                        prof, [(pi.pod, fe) for pi, fe, _ in items]
+                    )
+            except Exception:
+                logger.exception("batched device preemption failed")
+                nominated = [""] * len(items)
+            evict_ok = victim_uids is not None
+            # wait (bounded) for the evictions to propagate from the
+            # watch into the cache: the nominated pods retry WITHOUT
+            # backoff below -- their failure was just resolved by this
+            # wave's evictions, so backing off would only add the full
+            # 1s initial-backoff round trip to every preemption -- and
+            # an instant retry against a cache that still holds the
+            # victims would waste a scheduling cycle
+            if victim_uids:
+                with timeline.span("victim_wait"):
+                    deadline = time.monotonic() + 0.5
+                    pending = list(victim_uids)
+                    while pending and time.monotonic() < deadline:
+                        pending = [
+                            u for u in pending
+                            if self.cache.has_pod_uid(u)
+                        ]
+                        if pending:
+                            time.sleep(0.002)
+            with timeline.span("preempt_requeue"):
+                for (pi, fe, cycle), node in zip(items, nominated):
+                    if self.cache.has_pod_uid(pi.pod.metadata.uid):
+                        # stale parked record: the pod bound during the
+                        # deferral window (an informer update re-added
+                        # it); requeueing it would double-place a
+                        # running pod
+                        continue
+                    self.record_scheduling_failure(
+                        prof, pi, str(fe), "Unschedulable", node, cycle,
+                        # no-backoff retry only when the wave actually
+                        # evicted: otherwise the failure is persistent
+                        # and the 1s backoff must damp it
+                        skip_backoff=bool(node) and evict_ok,
+                    )
+            if any(nominated):
+                # once these preemptors bind, the cluster is full again:
+                # refresh the victim pack so the NEXT contention wave
+                # finds it (and its device upload) already warm
+                self._prewarm_next_commit = True
 
     def _bulk_binding_cycle_safe(
         self, items, pod_scheduling_cycle, snapshot=None

@@ -15,6 +15,7 @@ implementation is the parity oracle.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -165,6 +166,9 @@ class Preemptor:
         self._tensor_cache = NodeTensorCache()
         self._pack = None
         self._pack_key = None
+        self._pack_cv = threading.Condition()
+        self._prewarm_busy = False
+        self._last_adims = None
         self.device_preemptions = 0
         self.host_preemptions = 0
 
@@ -344,38 +348,79 @@ class Preemptor:
                 for pdb in pdbs
             ),
         )
-        if self._pack is None or self._pack_key != key:
-            self._pack = pack_preemption_state(snapshot, nt, pdbs)
-            self._pack_key = key
-        pack = self._pack
+        from kubernetes_tpu.utils import timeline as _tl
+        with _tl.span("pack_wait"), self._pack_cv:
+            # a prewarm in flight is about to deliver this exact pack:
+            # wait for it instead of duplicating ~0.3s of packing work
+            deadline = time.monotonic() + 2.0
+            while (
+                self._prewarm_busy
+                and self._pack_key != key
+                and time.monotonic() < deadline
+            ):
+                self._pack_cv.wait(0.05)
+            pack = self._pack if self._pack_key == key else None
+        if pack is None:
+            with _tl.span("pack_build"):
+                pack = pack_preemption_state(snapshot, nt, pdbs)
+            with self._pack_cv:
+                self._pack = pack
+                self._pack_key = key
         n = len(pack.node_names)
         b = len(pods)
 
         batch = pack_pod_batch(pods, nt.dims)
         mask_rows, mask_index = static_mask_compact(pods, snapshot, nt)
-        candidate = np.zeros((b, n), dtype=bool)
         nt_rows = np.array(
             [nt.row(name) for name in pack.node_names], dtype=np.int64
         )
-        # potential lists are shared across identical pods (preempt_batch
-        # caches them by statuses identity): materialize each list's
-        # boolean row once instead of a per-pod name-in-set scan
+        # candidate masks arrive PRE-DEDUPLICATED: the dedup key is
+        # (static-mask row, potential-list identity) -- both known per
+        # pod -- so a wave of identical pods shares one [N] row and the
+        # kernel never sees (nor np.unique's) a [B, N] matrix (measured
+        # ~1.1s at 1000x5000, half the wave)
         pot_rows: Dict[int, np.ndarray] = {}
+        cand_cache: Dict[Tuple[int, int], int] = {}
+        content_cache: Dict[bytes, int] = {}
+        cand_rows: List[np.ndarray] = []
+        cand_index = np.zeros(b, dtype=np.int32)
+        zero_row: Optional[int] = None
         for k, pod in enumerate(pods):
             if batch.unsatisfiable[k]:
-                continue  # no pod removal adds a resource dimension
-            row = mask_rows[mask_index[k]][nt_rows]
-            pot_key = id(potentials[k])
-            pot_row = pot_rows.get(pot_key)
-            if pot_row is None:
-                pot_row = np.zeros(n, dtype=bool)
-                idxs = [
-                    pack.node_index.get(ni.node_name)
-                    for ni in potentials[k]
-                ]
-                pot_row[[i for i in idxs if i is not None]] = True
-                pot_rows[pot_key] = pot_row
-            candidate[k] = row & pot_row
+                # no pod removal adds a resource dimension
+                if zero_row is None:
+                    zero_row = len(cand_rows)
+                    cand_rows.append(np.zeros(n, dtype=bool))
+                cand_index[k] = zero_row
+                continue
+            key = (int(mask_index[k]), id(potentials[k]))
+            u = cand_cache.get(key)
+            if u is None:
+                pot_key = id(potentials[k])
+                pot_row = pot_rows.get(pot_key)
+                if pot_row is None:
+                    pot_row = np.zeros(n, dtype=bool)
+                    idxs = [
+                        pack.node_index.get(ni.node_name)
+                        for ni in potentials[k]
+                    ]
+                    pot_row[[i for i in idxs if i is not None]] = True
+                    pot_rows[pot_key] = pot_row
+                row = mask_rows[mask_index[k]][nt_rows] & pot_row
+                # CONTENT-level dedup on top of the identity key: a
+                # deferred wave combines failures from several batches
+                # whose statuses/potential objects differ by identity
+                # but not content; without this the distinct-row count
+                # crosses its pad bucket and forks a multi-second
+                # kernel recompile mid-burst
+                ckey = row.tobytes()
+                u = content_cache.get(ckey)
+                if u is None:
+                    u = len(cand_rows)
+                    cand_rows.append(row)
+                    content_cache[ckey] = u
+                cand_cache[key] = u
+            cand_index[k] = u
 
         # pre-existing nominations (in-scan ones ride the kernel carry)
         pod_uids = {p.metadata.uid for p in pods}
@@ -397,17 +442,23 @@ class Preemptor:
         else:
             nom_req = np.zeros((0, nt.dims.num_dims), dtype=np.int32)
 
+        _span = _tl.span("preempt_device")
+        _span.__enter__()
         chosen, victims, viol, nviol = preempt_batch_device(
             pack,
             batch.requests,
             np.clip(
                 [p.spec.priority for p in pods], -(1 << 31), (1 << 31) - 2
             ).astype(np.int32),
-            candidate,
+            None,
             nom_req,
             np.array(nom_prio, dtype=np.int32),
             np.array(nom_node, dtype=np.int32),
+            cand_dedup=(np.stack(cand_rows), cand_index),
         )
+        _span.__exit__(None, None, None)
+        if getattr(pack, "last_adims", None) is not None:
+            self._last_adims = pack.last_adims
         out = []
         for k in range(b):
             idx = int(chosen[k])
@@ -422,6 +473,81 @@ class Preemptor:
                 )
             )
         return out
+
+    def _pack_cache_key(self, snapshot, pdbs):
+        return (
+            snapshot.generation,
+            tuple(
+                (
+                    pdb.metadata.namespace, pdb.metadata.name,
+                    pdb.metadata.resource_version,
+                    pdb.status.disruptions_allowed,
+                )
+                for pdb in pdbs
+            ),
+        )
+
+    def prewarm_pack_async(self, adims=None) -> None:
+        """Speculatively build + upload the victim-search pack for the
+        CURRENT snapshot on a helper thread. The BatchScheduler calls
+        this when a dispatched batch's demand exceeds the cluster's free
+        capacity -- preemption is then likely, and the ~0.25s host pack
+        plus the ~5MB device upload overlap the failing solve instead of
+        serializing into the wave."""
+        with self._pack_cv:
+            if self._prewarm_busy:
+                return
+            self._prewarm_busy = True
+            if adims is None:
+                adims = self._last_adims
+
+        def run() -> None:
+            try:
+                snapshot = self.algorithm.snapshot
+                pdbs = []
+                if self.client is not None:
+                    try:
+                        pdbs, _ = self.client.list_pdbs()
+                    except Exception:
+                        pass
+                key = self._pack_cache_key(snapshot, pdbs)
+                with self._pack_cv:
+                    if self._pack_key == key:
+                        return
+                from kubernetes_tpu.ops.preemption import (
+                    pack_preemption_state,
+                    upload_pack,
+                )
+                from kubernetes_tpu.tensors import NodeTensorCache
+
+                # own cache INSTANCE (update mutates arrays in place and
+                # the committer may be mid-wave on self._tensor_cache)
+                # but the SHARED dims/topology schema: a fresh
+                # ResourceDims could order resource columns differently
+                # and silently misalign the wave's pod packing against
+                # this pack
+                nt = NodeTensorCache(
+                    dims=self._tensor_cache.dims,
+                    topology_encoder=self._tensor_cache.topology,
+                ).update(snapshot)
+                pack = pack_preemption_state(snapshot, nt, pdbs)
+                if adims is not None:
+                    # start the slim device upload too (async): the
+                    # ~1.6MB transfer rides the link before the wave
+                    upload_pack(pack, tuple(adims))
+                with self._pack_cv:
+                    self._pack = pack
+                    self._pack_key = key
+            except Exception:
+                logger.exception("preemption pack prewarm failed")
+            finally:
+                with self._pack_cv:
+                    self._prewarm_busy = False
+                    self._pack_cv.notify_all()
+
+        threading.Thread(
+            target=run, name="preempt-prewarm", daemon=True
+        ).start()
 
     def _find_preemption_device(
         self, pod: Pod, potential, pdbs
@@ -493,10 +619,13 @@ class Preemptor:
 
     def preempt_batch(
         self, prof, items: List[Tuple[Pod, FitError]]
-    ) -> List[str]:
+    ) -> Tuple[List[str], List[str]]:
         """Preemption for a whole failed-pod group (priority-desc order)
         in ONE device round trip, then the per-pod API side effects in
-        order. Every pod must already be device_eligible. Returns the
+        order. Every pod must already be device_eligible. Returns
+        (nominated node per pod, evicted victim uids); "" = no
+        nomination for that pod. The victim uids let the caller wait for
+        the deletions to propagate into its cache before retrying the
         nominated node name per pod ("" = none)."""
         pods = []
         for pod, _ in items:
@@ -523,7 +652,12 @@ class Preemptor:
         # potential-node list ONCE instead of O(pods x nodes) times
         pot_cache: Dict[int, List] = {}
         for k, (item, pod) in enumerate(zip(items, pods)):
-            if pod is None or not self.pod_eligible_to_preempt_others(pod):
+            if pod is None or pod.spec.node_name:
+                # deleted, or a STALE failure record: the pod bound
+                # since (its signature would poison the wave's shared
+                # candidate row with a single-node mask)
+                continue
+            if not self.pod_eligible_to_preempt_others(pod):
                 continue
             pot_key = id(item[1].filtered_nodes_statuses)
             potential = pot_cache.get(pot_key)
@@ -540,7 +674,7 @@ class Preemptor:
             live_pods.append(pod)
             potentials.append(potential)
         if not live_pods:
-            return results
+            return results, []
         answers = self._device_answers(live_pods, potentials, pdbs)
         self.device_preemptions += len(live_pods)
         all_victims = {}
@@ -551,7 +685,8 @@ class Preemptor:
             if node_name:
                 metrics.preemption_victims.observe(len(victims))
                 if self._apply_preemption(
-                    prof, pod, node_name, victims, delete_victims=False
+                    prof, pod, node_name, victims,
+                    delete_victims=False, write_status=False,
                 ):
                     results[k] = node_name
                     for v in victims:
@@ -574,12 +709,18 @@ class Preemptor:
                     # for an eviction that never happened
                     logger.exception("bulk victim eviction")
                     evicted = False
-            if evicted:
-                for v in all_victims.values():
-                    waiting = prof.get_waiting_pod(v.metadata.uid)
-                    if waiting is not None:
-                        waiting.reject("preemption", "preempted")
-        return results
+            if not evicted:
+                # eviction failed: nominations stand but the cluster is
+                # unchanged -- callers must requeue WITH backoff (None
+                # sentinel), or the nominees hot-loop a full wave +
+                # eviction attempt against a persistent API failure
+                return results, None
+            for v in all_victims.values():
+                waiting = prof.get_waiting_pod(v.metadata.uid)
+                if waiting is not None:
+                    waiting.reject("preemption", "preempted")
+            return results, list(all_victims.keys())
+        return results, []
 
     def _clear_nomination(self, pod: Pod) -> None:
         self.queue.delete_nominated_pod_if_exists(pod)
@@ -601,6 +742,7 @@ class Preemptor:
         node_name: str,
         victims: List[Pod],
         delete_victims: bool = True,
+        write_status: bool = True,
     ) -> bool:
         """The API side effects of one successful preemption
         (scheduler.go:392): nominate, delete victims, clear superseded
@@ -608,9 +750,17 @@ class Preemptor:
         write failed and was rolled back (no victims were evicted) --
         callers must then report no nomination. ``delete_victims=False``
         lets preempt_batch evict the whole group's victims in one
-        transaction afterwards."""
+        transaction afterwards. ``write_status=False`` skips the API
+        nominatedNodeName write: the batched path defers it to
+        record_scheduling_failure's condition write, which happens
+        immediately after the pod is requeued -- the watch ECHO of a
+        status write arrives as a pod update, and an update for a pod
+        that is in no queue re-adds it to the activeQ
+        (scheduling_queue.update), so a write issued while the pod is
+        still parked for the wave creates a DUPLICATE scheduling of the
+        same pod (phantom demand, cascading over-eviction)."""
         self.queue.update_nominated_pod_for_node(pod, node_name)
-        if self.client is not None:
+        if self.client is not None and write_status:
             try:
                 def set_nominated(p: Pod) -> None:
                     p.status.nominated_node_name = node_name

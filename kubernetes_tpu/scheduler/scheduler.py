@@ -96,14 +96,21 @@ class Scheduler:
         reason: str,
         nominated_node: str,
         pod_scheduling_cycle: int,
+        skip_backoff: bool = False,
     ) -> None:
+        """``skip_backoff``: requeue straight to the activeQ -- used by
+        the batched preemption path for pods whose failure was just
+        resolved by the wave's own evictions (backoff exists to damp
+        retries against a persistent failure, which this is not; the
+        reference pays its 1s initial backoff here, scheduling_queue.go
+        :643, purely because its preemption is asynchronous)."""
         pod = pod_info.pod
         prof.recorder.eventf(
             pod, "Warning", "FailedScheduling", err_msg
         )  # scheduler.go:378
         try:
             self.queue.add_unschedulable_if_not_present(
-                pod_info, pod_scheduling_cycle
+                pod_info, pod_scheduling_cycle, skip_backoff=skip_backoff
             )
         except KeyError:
             pass  # already requeued via an informer update

@@ -76,8 +76,11 @@ def test_pallas_preempt_matches_xla(seed, m):
     u_pad = 8 * -(-rows.shape[0] // 8)
     rows_p = np.zeros((u_pad, candidate.shape[1]), bool)
     rows_p[: rows.shape[0]] = rows
+    active_bits = np.zeros(active.shape[0], dtype=np.int32)
+    for vi in range(v):
+        active_bits |= active[:, vi].astype(np.int32) << vi
     p_packed, _state = pallas_preempt_solve(
-        alloc, base, prio32, start.astype(np.float32), req, active,
+        alloc, base, prio32, start.astype(np.float32), req, active_bits,
         nom_req, nom_prio, nom_node,
         pods_req, pods_prio, rows_p,
         inverse.reshape(-1).astype(np.int32), np.ones(b, bool),
