@@ -126,6 +126,7 @@ def _build_pod(name: str, spec: Dict[str, Any], idx: int):
     w.container(
         cpu=str(spec.get("cpu", "100m")),
         memory=str(spec.get("memory", "128Mi")),
+        host_port=int(spec.get("host_port", 0)),
         **{
             k.replace("/", "__").replace(".", "_"): v
             for k, v in (spec.get("scalars") or {}).items()

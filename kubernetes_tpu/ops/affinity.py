@@ -421,6 +421,117 @@ def pack_affinity_batch(
     )
 
 
+def add_host_port_rows(
+    pods: List[Pod], snapshot: Snapshot, nt, af: Optional[AffinityBatch]
+) -> Optional[AffinityBatch]:
+    """Model WITHIN-BATCH host-port conflicts as synthetic anti-affinity
+    rows (nodeinfo/host_ports.go semantics): each distinct
+    (protocol, port, ip) in the batch becomes an anti row over a
+    synthetic per-node-unique value row, counts starting at zero
+    (conflicts with EXISTING pods are already baked into the static
+    mask, host_masks.static_mask_compact). A pod
+
+    - BUMPS its own (proto, port, ip) row when placed, and
+    - BLOCKS on every row it conflicts with: its own row, the wildcard
+      row of the same (proto, port) when it binds a specific IP, and
+      every specific-IP row of that (proto, port) when it binds the
+      wildcard -- exactly HostPortInfo.CheckConflict.
+
+    Returns the (possibly extended) AffinityBatch, a fresh one when the
+    batch had no other affinity, or None when the rows don't fit the
+    device envelope (callers fall back to the host path)."""
+    from kubernetes_tpu.cache.node_info import pod_host_ports
+
+    per_pod_ports = [pod_host_ports(p) for p in pods]
+    if not any(per_pod_ports):
+        return af
+    b = len(pods)
+    n_cap = nt.capacity
+    v_cap = value_capacity(n_cap)
+    if af is None:
+        noop = noop_affinity_tensors(b, n_cap)
+        af = AffinityBatch(
+            node_value=noop[0].copy(), counts_aff=noop[1].copy(),
+            row_key_aff=noop[2].copy(), pod_aff_rows=noop[3].copy(),
+            pod_self_match=noop[4].copy(), pod_bump_aff=noop[5].copy(),
+            counts_anti=noop[6].copy(), row_key_anti=noop[7].copy(),
+            pod_anti_rows=noop[8].copy(), pod_bump_anti=noop[9].copy(),
+            counts_exist=noop[10].copy(), row_key_exist=noop[11].copy(),
+            pod_exist_match=noop[12].copy(),
+            pod_bump_exist=noop[13].copy(),
+        )
+    # synthetic key whose value is the node's own row index (unique per
+    # node; value_capacity(n_cap) >= n_cap guarantees room)
+    keys_used = {
+        int(k)
+        for arr in (af.row_key_aff, af.row_key_anti, af.row_key_exist)
+        for k in arr
+        if k >= 0
+    }
+    key_free = next(
+        (
+            k
+            for k in range(af.node_value.shape[0])
+            if k not in keys_used and (af.node_value[k] == -1).all()
+        ),
+        None,
+    )
+    if key_free is None:
+        return None  # no key slot left: host path
+    infos = snapshot.list_node_infos()
+    for j, ni in enumerate(infos):
+        if ni.node is not None and j < n_cap:
+            af.node_value[key_free, j] = j
+
+    # distinct port identities -> anti rows
+    row_of: Dict[Tuple, int] = {}
+    by_proto_port: Dict[Tuple, List[Tuple]] = {}
+
+    def row_for(ident) -> Optional[int]:
+        r = row_of.get(ident)
+        if r is None:
+            used = int(np.count_nonzero(af.row_key_anti >= 0))
+            if used >= af.row_key_anti.shape[0]:
+                return None
+            r = used
+            af.row_key_anti[r] = key_free
+            row_of[ident] = r
+            by_proto_port.setdefault(ident[:2], []).append(ident)
+        return r
+
+    for i, ports in enumerate(per_pod_ports):
+        if not ports:
+            continue
+        for ip, proto, port in ports:
+            ident = (proto, port, ip or "0.0.0.0")
+            if row_for(ident) is None:
+                return None
+    for i, ports in enumerate(per_pod_ports):
+        if not ports:
+            continue
+        block_rows = set()
+        for ip, proto, port in ports:
+            ident = (proto, port, ip or "0.0.0.0")
+            r = row_of[ident]
+            af.pod_bump_anti[i, r] = 1
+            if ident[2] == "0.0.0.0":
+                # wildcard conflicts with every identity of (proto, port)
+                for other in by_proto_port.get(ident[:2], ()):
+                    block_rows.add(row_of[other])
+            else:
+                block_rows.add(r)
+                wild = (proto, port, "0.0.0.0")
+                if wild in row_of:
+                    block_rows.add(row_of[wild])
+        slots = list(af.pod_anti_rows[i])
+        free = [c for c, v in enumerate(slots) if v == -1]
+        if len(free) < len(block_rows):
+            return None  # not enough term slots: host path
+        for c, r in zip(free, sorted(block_rows)):
+            af.pod_anti_rows[i, c] = r
+    return af
+
+
 def cluster_has_required_anti_affinity(snapshot: Snapshot) -> bool:
     """True when any existing pod carries required anti-affinity -- such
     pods impose symmetric constraints on every incoming pod

@@ -12,12 +12,13 @@ The scheduling-framework contract stays intact: Reserve, Permit
 (gang-scheduling hook), PreBind, Bind and the failure/Unreserve paths run
 through the same Framework pipeline per pod (finish_schedule). Required
 (anti-)affinity, topology spread, the full default score family
-(including preferred inter-pod affinity), gang quorum masks, and batched
-preemption all solve on device; the few remaining shapes the solver
-doesn't model (host ports, volume-bound pods, spread+nodeSelector
-eligibility coupling -- see solver_supported) fall back to the
-sequential oracle path (attempt_schedule), exactly like the reference
-runs unsupported pods through extenders.
+(including preferred inter-pod affinity), host ports (static mask for
+existing pods + synthetic anti rows for within-batch conflicts), gang
+quorum masks, and batched preemption all solve on device; the few
+remaining shapes the solver doesn't model (volume-bound pods,
+spread+nodeSelector eligibility coupling -- see solver_supported) fall
+back to the sequential oracle path (attempt_schedule), exactly like the
+reference runs unsupported pods through extenders.
 """
 
 from __future__ import annotations
@@ -51,6 +52,7 @@ from kubernetes_tpu.ops.assignment import (
     solve_packed,
 )
 from kubernetes_tpu.ops.affinity import (
+    add_host_port_rows,
     batch_has_affinity,
     batch_has_required_anti_affinity,
     cluster_has_required_anti_affinity,
@@ -111,10 +113,9 @@ def solver_supported(pod: Pod) -> bool:
     # REQUIRED pod (anti-)affinity solves on device via the count-tensor
     # replay (ops/affinity.py); preferred terms ride the weighted
     # count-tensor score family (ops/scoring.py ipa_*). Host ports solve
-    # on device via the static mask (NodePorts folded into
-    # host_masks.static_mask_compact); the dispatcher serializes
-    # host-port pods one per solver batch so within-batch port
-    # interactions can't double-book (see schedule_batch).
+    # on device: existing-pod conflicts via the static mask (NodePorts
+    # folded into host_masks.static_mask_compact), within-batch
+    # conflicts via synthetic anti rows (affinity.add_host_port_rows).
     # volume feasibility (PVC binding, disk conflicts, zone/limit checks)
     # stays host-side
     for v in spec.volumes:
@@ -297,21 +298,6 @@ class BatchScheduler(Scheduler):
                     != pi.pod.spec.scheduler_name
                 ):
                     flush()
-                if any(
-                    p.host_port
-                    for c in pi.pod.spec.containers
-                    for p in c.ports
-                ):
-                    # NodePorts: the static mask row covers existing
-                    # pods only, so each host-port pod solves in its
-                    # OWN batch against a drained (fully committed)
-                    # cluster view -- no within-batch port double-book
-                    flush()
-                    self._drain_pending()
-                    solver_infos.append(pi)
-                    flush()
-                    self._drain_pending()
-                    continue
                 solver_infos.append(pi)
             else:
                 flush()
@@ -434,6 +420,10 @@ class BatchScheduler(Scheduler):
     def _pending_has_required_anti(self) -> bool:
         with self._pending_cv:
             return any(p.get("has_required_anti") for p in self._pending_q)
+
+    def _pending_has_ports(self) -> bool:
+        with self._pending_cv:
+            return any(p.get("has_ports") for p in self._pending_q)
 
     def _pending_has_scoring_terms(self) -> bool:
         with self._pending_cv:
@@ -561,7 +551,9 @@ class BatchScheduler(Scheduler):
             for p in pods
             for c in p.spec.topology_spread_constraints
         )
-        has_affinity = batch_has_affinity(pods)
+        batch_ports = any(pod_host_ports(p) for p in pods)
+        has_affinity_terms = batch_has_affinity(pods)
+        has_affinity = has_affinity_terms or batch_ports
         has_required_anti = batch_has_required_anti_affinity(pods)
         prof0 = self.profiles.get(pods[0].spec.scheduler_name)
         # gated on the profile actually scoring with InterPodAffinity --
@@ -610,7 +602,11 @@ class BatchScheduler(Scheduler):
             if nominated_by_node else set()
         )
         drained(
-            has_hard_spread or has_affinity or score_dynamic
+            has_hard_spread or has_affinity_terms or score_dynamic
+            # a port batch must see in-flight PORT placements committed
+            # into the static mask; port-free in-flight batches cannot
+            # conflict, so they don't force the drain
+            or (batch_ports and self._pending_has_ports())
             # an in-flight batch carrying required anti-affinity or
             # scoring-relevant terms imposes symmetric constraints this
             # batch can only see once its placements are committed
@@ -634,8 +630,11 @@ class BatchScheduler(Scheduler):
         # incoming pod symmetrically (filtering.go:404) -- such clusters
         # need the affinity tensors even for batches without affinity, and
         # their counts must include any in-flight placements
-        if not has_affinity and cluster_has_required_anti_affinity(snapshot):
+        if not has_affinity_terms and cluster_has_required_anti_affinity(
+            snapshot
+        ):
             has_affinity = True
+            has_affinity_terms = True
             if drained(True):
                 self.cache.update_snapshot(snapshot)
         # existing pods with symmetric scoring terms make EVERY batch's
@@ -820,12 +819,29 @@ class BatchScheduler(Scheduler):
                 return None
         if has_affinity:
             affinity = pack_affinity_batch(ordered_pods, snapshot, nt)
-            if affinity is None:
+            if affinity is None and has_affinity_terms:
+                # envelope exceeded (real affinity/exist rows expected
+                # but the packer bailed): the host path keeps full
+                # correctness -- port-only batches fall through to the
+                # port-row builder instead
                 self.envelope_fallbacks += 1
                 for pi in solver_infos:
                     self.pods_fallback += 1
                     self.attempt_schedule(pi)
                 return None
+            if batch_ports:
+                # within-batch host-port conflicts ride synthetic anti
+                # rows (ops/affinity.add_host_port_rows); existing-pod
+                # conflicts are already in the static mask
+                affinity = add_host_port_rows(
+                    ordered_pods, snapshot, nt, affinity
+                )
+                if affinity is None:
+                    self.envelope_fallbacks += 1
+                    for pi in solver_infos:
+                        self.pods_fallback += 1
+                        self.attempt_schedule(pi)
+                    return None
 
         solve_timer = metrics.SinceTimer(metrics.batch_solve_duration)
 
@@ -974,6 +990,7 @@ class BatchScheduler(Scheduler):
             return {
                 "solver_infos": list(solver_infos),
                 "has_required_anti": has_required_anti,
+                "has_ports": batch_ports,
                 "has_scoring_terms": has_scoring_terms,
                 "order": order,
                 "assignments_dev": assignments_dev,
@@ -1088,6 +1105,7 @@ class BatchScheduler(Scheduler):
             # copy: the caller's list is cleared after dispatch returns
             "solver_infos": list(solver_infos),
             "has_required_anti": has_required_anti,
+            "has_ports": batch_ports,
             "has_scoring_terms": has_scoring_terms,
             "order": order,
             "assignments_dev": assignments_dev,

@@ -167,6 +167,7 @@ class Preemptor:
         self._pack = None
         self._pack_key = None
         self._pack_cv = threading.Condition()
+        self._nt_lock = threading.Lock()  # dims/topology interner guard
         self._prewarm_busy = False
         self._last_adims = None
         self.device_preemptions = 0
@@ -336,18 +337,11 @@ class Preemptor:
         from kubernetes_tpu.tensors import pack_pod_batch
 
         snapshot = self.algorithm.snapshot
-        nt = self._tensor_cache.update(snapshot)
-        key = (
-            snapshot.generation,
-            tuple(
-                (
-                    pdb.metadata.namespace, pdb.metadata.name,
-                    pdb.metadata.resource_version,
-                    pdb.status.disruptions_allowed,
-                )
-                for pdb in pdbs
-            ),
-        )
+        # the interners inside dims/topology are check-then-insert; the
+        # prewarm thread updates a sibling cache sharing them
+        with self._nt_lock:
+            nt = self._tensor_cache.update(snapshot)
+        key = self._pack_cache_key(snapshot, pdbs)
         from kubernetes_tpu.utils import timeline as _tl
         with _tl.span("pack_wait"), self._pack_cv:
             # a prewarm in flight is about to deliver this exact pack:
@@ -526,18 +520,29 @@ class Preemptor:
                 # ResourceDims could order resource columns differently
                 # and silently misalign the wave's pod packing against
                 # this pack
-                nt = NodeTensorCache(
-                    dims=self._tensor_cache.dims,
-                    topology_encoder=self._tensor_cache.topology,
-                ).update(snapshot)
+                with self._nt_lock:
+                    nt = NodeTensorCache(
+                        dims=self._tensor_cache.dims,
+                        topology_encoder=self._tensor_cache.topology,
+                    ).update(snapshot)
                 pack = pack_preemption_state(snapshot, nt, pdbs)
-                if adims is not None:
+                if adims is not None and not pdbs and pack.v_max <= 32:
                     # start the slim device upload too (async): the
-                    # ~1.6MB transfer rides the link before the wave
+                    # ~1.6MB transfer rides the link before the wave.
+                    # Gated like preempt_batch_device's pallas path --
+                    # PDB / v_max>32 waves take the XLA kernel and
+                    # would only waste the ~0.3s link transfer
                     upload_pack(pack, tuple(adims))
                 with self._pack_cv:
-                    self._pack = pack
-                    self._pack_key = key
+                    if (
+                        self._pack_key != key
+                        and self.algorithm.snapshot.generation
+                        == key[0]
+                    ):
+                        # publish only while still current: a wave may
+                        # have installed a NEWER pack meanwhile
+                        self._pack = pack
+                        self._pack_key = key
             except Exception:
                 logger.exception("preemption pack prewarm failed")
             finally:

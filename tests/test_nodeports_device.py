@@ -1,7 +1,8 @@
 """NodePorts on device: host-port pods solve through the batch solver
-(static-mask fold + one-per-batch serialization, VERDICT r3 missing #6)
-with differential checks against the NodePorts plugin semantics
-(reference nodeports/node_ports.go)."""
+(existing-pod conflicts in the static mask; within-batch conflicts as
+synthetic anti rows, ops/affinity.add_host_port_rows) with differential
+checks against the NodePorts plugin semantics (reference
+nodeports/node_ports.go)."""
 
 import time
 
@@ -129,5 +130,77 @@ class TestNodePortsDeviceE2E:
         bound = [p for p in cur if p.spec.node_name]
         assert len(bound) == 3, f"bound {len(bound)}, want exactly 3"
         assert len({p.spec.node_name for p in bound}) == 3
+        sched.stop()
+        informers.stop()
+
+
+class TestWithinBatchPortRows:
+    """Within-batch conflicts now solve via synthetic anti rows
+    (ops/affinity.add_host_port_rows) in ONE batch instead of
+    one-pod-per-batch serialization."""
+
+    @pytest.mark.parametrize("seed", [0, 7, 21])
+    def test_random_port_mix_never_double_books(self, seed):
+        import random
+
+        from kubernetes_tpu.cache.node_info import (
+            HostPortInfo,
+            pod_host_ports,
+        )
+
+        rng = random.Random(seed)
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(client, informers, batch=True, max_batch=64)
+        for i in range(10):
+            client.create_node(
+                make_node(f"n{i}").capacity(
+                    cpu="16", memory="32Gi", pods=30
+                ).obj()
+            )
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        pods = []
+        for i in range(24):
+            port = rng.choice([8080, 8080, 9090])
+            proto = rng.choice(["TCP", "TCP", "UDP"])
+            ip = rng.choice(["", "", "10.0.0.1", "10.0.0.2"])
+            pods.append(_port_pod(f"hp{i}", port, ip=ip, proto=proto))
+        for p in pods:
+            client.create_pod(p)
+        sched.start()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            cur, _ = client.list_pods()
+            pend = [p for p in cur if not p.spec.node_name]
+            if not pend or all(
+                any(
+                    c.type == "PodScheduled" and c.status == "False"
+                    for c in p.status.conditions
+                )
+                for p in pend
+            ):
+                break
+            time.sleep(0.05)
+        sched.wait_for_inflight_binds()
+        cur, _ = client.list_pods()
+        by_node = {}
+        for p in cur:
+            if p.spec.node_name:
+                by_node.setdefault(p.spec.node_name, []).append(p)
+        # invariant: no node's bound pods conflict
+        for node, plist in by_node.items():
+            hp = HostPortInfo()
+            for p in plist:
+                for ip, proto, port in pod_host_ports(p):
+                    assert not hp.conflicts(ip, proto, port), (
+                        f"double-booked {proto}:{port}@{ip} on {node}"
+                    )
+                    hp.add(ip, proto, port)
+        # with 10 nodes, every 8080-wildcard-free combination should
+        # bind; at minimum most pods do, all on the device path
+        assert sched.pods_fallback == 0
         sched.stop()
         informers.stop()
