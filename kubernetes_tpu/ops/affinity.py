@@ -447,7 +447,8 @@ def add_host_port_rows(
         return af
     b = len(pods)
     n_cap = nt.capacity
-    v_cap = value_capacity(n_cap)
+    # node-index values must fit the value axis of the counts arrays
+    assert value_capacity(n_cap) >= n_cap
     if af is None:
         noop = noop_affinity_tensors(b, n_cap)
         af = AffinityBatch(

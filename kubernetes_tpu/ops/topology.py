@@ -85,6 +85,33 @@ class SpreadBatch:
         return self.group_counts.shape[0]
 
 
+def _eligibility_sig(pod: Pod) -> Tuple:
+    """Signature of the pod's node-affinity/selector scoping: spread
+    pair counting runs only over nodes the pod itself could land on
+    (filtering.go:245 PodMatchesNodeSelectorAndAffinityTerms), so pods
+    with different scoping cannot share a group."""
+    spec = pod.spec
+    sel = tuple(sorted(spec.node_selector.items()))
+    aff: Tuple = ()
+    if spec.affinity is not None and spec.affinity.node_affinity is not None:
+        na = spec.affinity.node_affinity
+        if na.required_during_scheduling is not None:
+            aff = tuple(
+                (
+                    tuple(
+                        (r.key, r.operator, tuple(r.values))
+                        for r in term.match_expressions
+                    ),
+                    tuple(
+                        (r.key, r.operator, tuple(r.values))
+                        for r in term.match_fields
+                    ),
+                )
+                for term in na.required_during_scheduling.node_selector_terms
+            )
+    return (sel, aff)
+
+
 def pack_spread_batch(
     pods: List[Pod], snapshot: Snapshot, nt: NodeTensor
 ) -> Optional[SpreadBatch]:
@@ -92,7 +119,8 @@ def pack_spread_batch(
     groups/values/constraints) -- caller falls back to the host path."""
     b = len(pods)
     groups: Dict[Tuple, int] = {}
-    specs: List[Tuple[str, str, Optional[LabelSelector]]] = []  # ns, key, sel
+    # ns, key, sel, representative pod (its node-affinity scopes the group)
+    specs: List[Tuple[str, str, Optional[LabelSelector], Pod]] = []
 
     pod_groups = np.full((b, MAX_CONSTRAINTS_PER_POD), -1, dtype=np.int32)
     pod_max_skew = np.zeros((b, MAX_CONSTRAINTS_PER_POD), dtype=np.int32)
@@ -128,22 +156,17 @@ def pack_spread_batch(
         keys = {c.topology_key for c in hard}
         if len(keys) > 1 and any(key_incomplete(k) for k in keys):
             return None
-        # Pair counting is scoped to nodes passing the pod's own
-        # nodeSelector/affinity (filtering.go:245); grouped counts can't
-        # express per-pod eligibility, so such pods take the host path.
-        if hard and (
-            pod.spec.node_selector
-            or (
-                pod.spec.affinity is not None
-                and pod.spec.affinity.node_affinity is not None
-            )
-        ):
-            return None
         for ci, c in enumerate(hard):
+            # pair counting is scoped to nodes passing the pod's own
+            # nodeSelector/affinity (filtering.go:245): the scoping is
+            # part of the group identity, and the group's node_value
+            # row is -1 on out-of-scope nodes (no counts, no bumps,
+            # infeasible there -- matching the static mask)
             sig = (
                 pod.metadata.namespace,
                 c.topology_key,
                 _selector_sig(c.label_selector),
+                _eligibility_sig(pod),
             )
             g = groups.get(sig)
             if g is None:
@@ -152,7 +175,10 @@ def pack_spread_batch(
                 g = len(groups)
                 groups[sig] = g
                 specs.append(
-                    (pod.metadata.namespace, c.topology_key, c.label_selector)
+                    (
+                        pod.metadata.namespace, c.topology_key,
+                        c.label_selector, pod,
+                    )
                 )
             pod_groups[i, ci] = g
             pod_max_skew[i, ci] = c.max_skew
@@ -166,7 +192,7 @@ def pack_spread_batch(
 
     pod_match = np.zeros((b, MAX_GROUPS), dtype=np.int32)
     for i, pod in enumerate(pods):
-        for g, (ns, _key, sel) in enumerate(specs):
+        for g, (ns, _key, sel, _rep) in enumerate(specs):
             if pod.metadata.namespace == ns and labels_match_selector(
                 pod.metadata.labels, sel
             ):
@@ -178,12 +204,21 @@ def pack_spread_batch(
     value_valid = np.zeros((MAX_GROUPS, v_cap), dtype=bool)
     node_value = np.full((MAX_GROUPS, n_cap), -1, dtype=np.int32)
 
-    for g, (ns, key, sel) in enumerate(specs):
+    from kubernetes_tpu.plugins.nodeaffinity import (
+        pod_matches_node_selector_and_affinity,
+    )
+
+    for g, (ns, key, sel, rep) in enumerate(specs):
+        scoped = bool(_eligibility_sig(rep) != ((), ()))
         value_ids: Dict[str, int] = {}
         for j, ni in enumerate(infos):
             node = ni.node
             if node is None:
                 continue
+            if scoped and not pod_matches_node_selector_and_affinity(
+                rep, ni
+            ):
+                continue  # out of the owner pod's scope: -1 everywhere
             val = node.metadata.labels.get(key)
             if val is None:
                 continue  # node lacks the key: hard-excluded for this group

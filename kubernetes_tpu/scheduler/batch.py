@@ -98,11 +98,16 @@ def solver_supported(pod: Pod) -> bool:
     back to the sequential path (still fully correct, just not batched)."""
     spec = pod.spec
     # hard spread solves on device via the group-count scan
-    # (ops/topology.py), soft spread via the scoring tensors
-    # (ops/scoring.py); combining spread with node selectors changes
-    # pair-count eligibility per pod, which shared group counts can't
-    # express -- those pods take the host path
-    if spec.topology_spread_constraints and (
+    # (ops/topology.py) -- including spread coupled with node
+    # selectors/affinity, whose per-pod pair-count eligibility scopes
+    # the group's node_value row (topology._eligibility_sig); soft
+    # spread rides the scoring tensors (ops/scoring.py). Soft spread
+    # with node scoping still can't share score groups, so it falls
+    # back below.
+    if any(
+        c.when_unsatisfiable != "DoNotSchedule"
+        for c in spec.topology_spread_constraints
+    ) and (
         spec.node_selector
         or (
             spec.affinity is not None
@@ -837,6 +842,10 @@ class BatchScheduler(Scheduler):
                     ordered_pods, snapshot, nt, affinity
                 )
                 if affinity is None:
+                    # port-row envelope exceeded: the sequential filter
+                    # must see every in-flight placement committed (a
+                    # port-only batch may not have drained above)
+                    self._drain_pending()
                     self.envelope_fallbacks += 1
                     for pi in solver_infos:
                         self.pods_fallback += 1

@@ -534,13 +534,15 @@ class Preemptor:
                     # would only waste the ~0.3s link transfer
                     upload_pack(pack, tuple(adims))
                 with self._pack_cv:
-                    if (
-                        self._pack_key != key
-                        and self.algorithm.snapshot.generation
-                        == key[0]
-                    ):
-                        # publish only while still current: a wave may
-                        # have installed a NEWER pack meanwhile
+                    installed_gen = (
+                        self._pack_key[0]
+                        if self._pack_key is not None else -1
+                    )
+                    if self._pack_key != key and installed_gen <= key[0]:
+                        # never clobber a NEWER pack a wave installed
+                        # meanwhile; an older installed pack (or none)
+                        # is always worth replacing -- a wave blocked
+                        # in pack_wait may be waiting for this exact key
                         self._pack = pack
                         self._pack_key = key
             except Exception:

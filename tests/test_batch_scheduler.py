@@ -317,9 +317,19 @@ class TestSolverSupported:
             ).obj()
         )
 
-    def test_spread_plus_node_selector_not_supported(self):
-        assert not solver_supported(
+    def test_hard_spread_plus_node_selector_supported(self):
+        # per-group eligibility scoping (topology._eligibility_sig)
+        # keeps this on device now
+        assert solver_supported(
             make_pod("p").spread_constraint(1, "zone")
+            .node_selector(pool="x").obj()
+        )
+
+    def test_soft_spread_plus_node_selector_not_supported(self):
+        assert not solver_supported(
+            make_pod("p").spread_constraint(
+                1, "zone", when_unsatisfiable="ScheduleAnyway"
+            )
             .node_selector(pool="x").obj()
         )
 

@@ -147,3 +147,85 @@ def _run(seed, batch):
 @pytest.mark.parametrize("seed", [2, 13, 37, 71])
 def test_full_score_surface_batch_matches_sequential(seed):
     assert _run(seed, batch=True) == _run(seed, batch=False)
+
+
+def _build_scoped_spread_batch(rng):
+    """Hard zone-spread COUPLED with node-pool selectors (VERDICT r4
+    missing #6): pair counting must scope to each pod's eligible
+    nodes."""
+    out = []
+    for i in range(20):
+        w = (
+            make_pod(f"m{i}")
+            .labels(app="web")
+            .container(cpu="100m", memory="128Mi")
+        )
+        roll = rng.random()
+        if roll < 0.4:
+            w.spread_constraint(
+                1, "zone", when_unsatisfiable="DoNotSchedule",
+                match_labels={"app": "web"},
+            ).node_selector(pool="a")
+        elif roll < 0.6:
+            w.spread_constraint(
+                1, "zone", when_unsatisfiable="DoNotSchedule",
+                match_labels={"app": "web"},
+            ).node_selector(pool="b")
+        elif roll < 0.8:
+            w.spread_constraint(
+                2, "zone", when_unsatisfiable="DoNotSchedule",
+                match_labels={"app": "web"},
+            )
+        out.append(w.obj())
+    return out
+
+
+def _run_scoped(seed, batch):
+    rng = random.Random(seed)
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(
+        client, informers, batch=batch, max_batch=64,
+        percentage_of_nodes_to_score=100, rng=_KeepFirstRng(),
+    )
+    for i in range(18):
+        client.create_node(
+            make_node(f"n{i}")
+            .capacity(cpu="8", memory="16Gi", pods=20)
+            .labels(zone=f"z{i % 3}", pool="a" if i % 2 == 0 else "b")
+            .obj()
+        )
+    # seed a few existing matching pods so initial counts differ by pool
+    for i in range(5):
+        p = (
+            make_pod(f"ex{i}").labels(app="web")
+            .container(cpu="100m", memory="128Mi")
+            .node(f"n{i}")
+            .obj()
+        )
+        client.create_pod(p)
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    for p in _build_scoped_spread_batch(rng):
+        client.create_pod(p)
+    sched.start()
+    pods = _wait_decided(client, sched, 20)
+    fallback = sched.pods_fallback if batch else None
+    sched.stop()
+    informers.stop()
+    return {
+        p.metadata.name: p.spec.node_name
+        for p in pods
+        if p.metadata.name.startswith("m")
+    }, fallback
+
+
+@pytest.mark.parametrize("seed", [3, 17, 53])
+def test_spread_with_node_selector_batch_matches_sequential(seed):
+    got_batch, fallback = _run_scoped(seed, batch=True)
+    got_seq, _ = _run_scoped(seed, batch=False)
+    assert got_batch == got_seq
+    # the coupling solves ON DEVICE now (no solver_supported carve-out)
+    assert fallback == 0

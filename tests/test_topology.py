@@ -59,8 +59,10 @@ class TestPackSpreadBatch:
         assert sp.pod_self[:, 0].all()
         assert sp.pod_match[:, 0].all()
 
-    def test_node_selector_combo_falls_back(self):
+    def test_node_selector_combo_scopes_the_group(self):
         nodes = _zone_cluster()
+        for i, nd in enumerate(nodes):
+            nd.metadata.labels["pool"] = "x" if i % 2 == 0 else "y"
         snap = new_snapshot([], nodes)
         nt = NodeTensorCache().update(snap)
         pod = (
@@ -69,7 +71,16 @@ class TestPackSpreadBatch:
             .node_selector(pool="x")
             .obj()
         )
-        assert pack_spread_batch([pod], snap, nt) is None
+        sp = pack_spread_batch([pod], snap, nt)
+        assert sp is not None
+        g = int(sp.pod_groups[0, 0])
+        # out-of-scope (pool=y) nodes carry -1 in the group's value row
+        for j, nd in enumerate(nodes):
+            v = int(sp.node_value[g, nt.row(nd.metadata.name)])
+            if nd.metadata.labels["pool"] == "x":
+                assert v >= 0
+            else:
+                assert v == -1
 
 
 class TestSpreadScan:
