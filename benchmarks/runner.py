@@ -171,6 +171,18 @@ def _build_pod(name: str, spec: Dict[str, Any], idx: int):
         w.node_affinity_in(naff["key"], list(values))
     for s in range(int(spec.get("secret_volumes", 0))):
         w.secret_volume(f"secret-{idx % 16}-{s}")
+    numa = spec.get("numa_aligned")
+    if numa:
+        w.pod.metadata.annotations[
+            "numa.kubernetes-tpu.io/aligned"
+        ] = str(numa)
+    pvs = spec.get("pvs")
+    if pvs:
+        # one pre-bound PVC per pod (reference SchedulingInTreePVs /
+        # SchedulingCSIPVs shape, scheduler_perf performance-config
+        # :44/:87); the PVC/PV pair is created by run_workload
+        for k in range(int(pvs.get("per_pod", 1))):
+            w.pvc(f"pvc-{w.pod.metadata.name}-{k}")
     return w.obj()
 
 
@@ -207,6 +219,11 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         )
         nw.label(ZONE_LABEL, f"zone-{i % zones}")
         nw.label(HOSTNAME_LABEL, f"node-{i}")
+        if node_spec.get("numa_groups"):
+            nw.label(
+                "numa.kubernetes-tpu.io/gpu-groups",
+                str(node_spec["numa_groups"]),
+            )
         client.create_node(nw.obj())
 
     for svc in wl.get("services") or []:
@@ -220,6 +237,43 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
     # SchedulingSecrets (reference performance-config.yaml): pods mount
     # secret volumes; the pool matches _build_pod's secret-{idx%16}-{s}
     # naming so every reference resolves to a stored Secret
+    # pre-bound PVC/PV pairs for PV workloads: every pod with a "pvs"
+    # spec references pvc-{podname}-{k}, bound 1:1 to a PV. "csi" PVs
+    # carry a csi driver source (attach limits resolve them -> exact
+    # host path); "simple" PVs have no source/zone/affinity (provably
+    # node-independent -> the solver takes them)
+    def _make_pv_pairs(names: List[str], pvs_spec: Dict[str, Any]) -> None:
+        from kubernetes_tpu.api.types import (
+            PersistentVolume, PersistentVolumeClaim,
+        )
+
+        per_pod = int(pvs_spec.get("per_pod", 1))
+        kind = pvs_spec.get("type", "simple")
+        for nm in names:
+            for k in range(per_pod):
+                cn = f"pvc-{nm}-{k}"
+                vn = f"pv-{nm}-{k}"
+                server.create(
+                    PersistentVolumeClaim(
+                        metadata=ObjectMeta(
+                            name=cn, namespace="default"
+                        ),
+                        volume_name=vn,
+                        requested_bytes=1 << 30,
+                    )
+                )
+                pv = PersistentVolume(
+                    # cluster-scoped: the PV lister looks up namespace ""
+                    metadata=ObjectMeta(name=vn, namespace=""),
+                    capacity_bytes=1 << 30,
+                    claim_ref_namespace="default",
+                    claim_ref_name=cn,
+                )
+                if kind == "csi":
+                    pv.csi_driver = "ebs.csi.aws.com"
+                    pv.csi_volume_handle = vn
+                server.create(pv)
+
     n_sec = int((wl.get("pod") or {}).get("secret_volumes", 0) or 0)
     if n_sec:
         from kubernetes_tpu.api.types import Secret
@@ -269,6 +323,15 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         # -- init fill (off the clock) ------------------------------------------
         init_spec = wl.get("init_pod") or wl.get("pod") or {}
         init_n = int(wl.get("init_pods", 0))
+        if init_n and init_spec.get("pvs"):
+            _make_pv_pairs(
+                [f"init-{i}" for i in range(init_n)], init_spec["pvs"]
+            )
+        if (wl.get("pod") or {}).get("pvs"):
+            _make_pv_pairs(
+                [f"measure-{i}" for i in range(int(wl["measure_pods"]))],
+                (wl.get("pod") or {})["pvs"],
+            )
         if init_n:
             init_names = [f"init-{i}" for i in range(init_n)]
             coll = BindCollector(server, init_names)

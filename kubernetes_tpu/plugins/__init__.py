@@ -83,6 +83,12 @@ def new_in_tree_registry() -> Registry:
     )
     r.register(volumes.VolumeZone.NAME, lambda a, h: volumes.VolumeZone(h))
     r.register(volumes.CSILimits.NAME, lambda a, h: volumes.CSILimits(h))
+    from kubernetes_tpu.plugins import numa
+
+    r.register(
+        numa.NodeResourcesNumaAligned.NAME,
+        lambda a, h: numa.NodeResourcesNumaAligned(h),
+    )
     r.register(volumes.EBSLimits.NAME, lambda a, h: volumes.EBSLimits(h))
     r.register(volumes.GCEPDLimits.NAME, lambda a, h: volumes.GCEPDLimits(h))
     r.register(

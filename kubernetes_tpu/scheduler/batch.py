@@ -37,6 +37,7 @@ import jax.numpy as jnp
 
 from kubernetes_tpu.api.types import Binding, POD_GROUP_LABEL, Pod
 from kubernetes_tpu.cache.node_info import pod_host_ports
+from kubernetes_tpu.plugins.numa import ALIGNED_ANNOTATION
 from kubernetes_tpu.framework.interface import (
     CycleState,
     FitError,
@@ -97,6 +98,11 @@ def solver_supported(pod: Pod) -> bool:
     """Constraints the device solver models today. Anything else falls
     back to the sequential path (still fully correct, just not batched)."""
     spec = pod.spec
+    # single-NUMA-aligned extended resources keep the host path: the
+    # per-node best-fit group bookkeeping (plugins/numa.py) is stateful
+    # per placement in ways the batch replay does not model
+    if pod.metadata.annotations.get(ALIGNED_ANNOTATION):
+        return False
     # hard spread solves on device via the group-count scan
     # (ops/topology.py) -- including spread coupled with node
     # selectors/affinity, whose per-pod pair-count eligibility scopes
@@ -121,11 +127,14 @@ def solver_supported(pod: Pod) -> bool:
     # on device: existing-pod conflicts via the static mask (NodePorts
     # folded into host_masks.static_mask_compact), within-batch
     # conflicts via synthetic anti rows (affinity.add_host_port_rows).
-    # volume feasibility (PVC binding, disk conflicts, zone/limit checks)
-    # stays host-side
+    # volume feasibility: pods whose volume filters are provably
+    # node-independent (bound PVCs to simple PVs) ride the solver; the
+    # caller couples this with plugins.volumes.volumes_device_safe
+    # (which needs the PVC/PV listers) -- solver_supported itself only
+    # screens the DIRECT sources the restrictions/limits plugins read
     for v in spec.volumes:
         if (
-            v.pvc_claim_name or v.gce_pd_name or v.aws_ebs_volume_id
+            v.gce_pd_name or v.aws_ebs_volume_id
             or v.iscsi_target or v.rbd_image
         ):
             return False
@@ -231,6 +240,7 @@ class BatchScheduler(Scheduler):
         # committer loop, or the dispatcher on the synchronous paths,
         # which drain the pipeline first)
         self._deferred_preempt: List = []
+        self._volume_listers = None
         self._deferred_since = 0.0
         self._prewarm_next_commit = False
         self._committer_stop = False
@@ -292,8 +302,12 @@ class BatchScheduler(Scheduler):
         for pi in batch_infos:
             if self._skip_pod_schedule(pi.pod):
                 continue
-            if solver_supported(pi.pod) and not any(
-                e.is_interested(pi.pod) for e in extenders
+            if (
+                solver_supported(pi.pod)
+                and self._volumes_device_safe(pi.pod)
+                and not any(
+                    e.is_interested(pi.pod) for e in extenders
+                )
             ):
                 # one profile per solver batch: score weights and owner
                 # lookups are profile-scoped (the sequential path resolves
@@ -425,6 +439,22 @@ class BatchScheduler(Scheduler):
     def _pending_has_required_anti(self) -> bool:
         with self._pending_cv:
             return any(p.get("has_required_anti") for p in self._pending_q)
+
+    def _volumes_device_safe(self, pod: Pod) -> bool:
+        """plugins.volumes.volumes_device_safe against the live
+        informer listers (lazily constructed)."""
+        if not any(v.pvc_claim_name for v in pod.spec.volumes):
+            return True
+        listers = self._volume_listers
+        if listers is None:
+            from kubernetes_tpu.plugins.volumes import _Listers
+
+            prof = next(iter(self.profiles.values()), None)
+            listers = _Listers(prof)
+            self._volume_listers = listers
+        from kubernetes_tpu.plugins.volumes import volumes_device_safe
+
+        return volumes_device_safe(pod, listers)
 
     def _pending_has_ports(self) -> bool:
         with self._pending_cv:

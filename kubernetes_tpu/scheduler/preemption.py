@@ -153,6 +153,9 @@ class Preemptor:
         "EBSLimits", "GCEPDLimits", "AzureDiskLimits",
         "NodeVolumeLimitsCSI", "VolumeBinding", "VolumeZone",
         "PodTopologySpread", "InterPodAffinity",
+        # no-op for pods without the numa opt-in annotation, and
+        # annotated pods are rejected by solver_supported above
+        "NodeResourcesNumaAligned",
     })
 
     def __init__(self, algorithm, queue, client) -> None:
@@ -280,6 +283,12 @@ class Preemptor:
         from kubernetes_tpu.scheduler.batch import solver_supported
 
         if not solver_supported(pod):
+            return False
+        if any(v.pvc_claim_name for v in pod.spec.volumes):
+            # bound-simple-PV pods are solver-safe for PLACEMENT, but
+            # the victim search keeps them on the host oracle: volume
+            # state can change between the wave and the retry, and the
+            # exact oracle re-resolves claims per node
             return False
         # solver_supported admits required pod (anti-)affinity and hard
         # spread (the batch solver models them via count tensors); the

@@ -40,6 +40,8 @@ def default_plugins() -> Plugins:
                 P("VolumeZone"),
                 P("PodTopologySpread"),
                 P("InterPodAffinity"),
+                # no-op without the numa opt-in annotation
+                P("NodeResourcesNumaAligned"),
             ]
         ),
         pre_score=PluginSet(
@@ -61,11 +63,14 @@ def default_plugins() -> Plugins:
                 P("DefaultPodTopologySpread", weight=1),
                 P("PodTopologySpread", weight=2),
                 P("TaintToleration", weight=1),
+                P("NodeResourcesNumaAligned", weight=1),
             ]
         ),
         # v1.18 binds volumes via the scheduler's VolumeBinder call
         # (scheduler.go:693 bindVolumes); this build routes it through the
         # PreBind extension point of the same plugin (volumes.py docstring)
+        reserve=PluginSet(enabled=[P("NodeResourcesNumaAligned")]),
+        unreserve=PluginSet(enabled=[P("NodeResourcesNumaAligned")]),
         pre_bind=PluginSet(enabled=[P("VolumeBinding")]),
         # gang scheduling: the out-of-tree coscheduling pattern, enabled by
         # default in this build (no-op for pods without a pod-group label)

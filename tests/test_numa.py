@@ -1,0 +1,135 @@
+"""Single-NUMA-aligned extended resources (plugins/numa.py): the
+device-manager hint semantics (manager.go:103 GetTopologyHints) lifted
+to scheduling time. BASELINE config #4."""
+
+import time
+
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.cache.node_info import NodeInfo
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.framework.interface import CycleState
+from kubernetes_tpu.plugins.numa import (
+    ALIGNED_ANNOTATION,
+    ASSIGNED_ANNOTATION,
+    GROUPS_LABEL,
+    NodeResourcesNumaAligned,
+    group_free,
+)
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _gpu_pod(name, gpus, aligned=True):
+    w = make_pod(name).container(
+        cpu="100m", memory="128Mi", **{"nvidia_com__gpu": gpus}
+    )
+    if aligned:
+        w.pod.metadata.annotations[ALIGNED_ANNOTATION] = "nvidia.com/gpu"
+    return w.obj()
+
+
+def _gpu_node(name, groups="4_4"):
+    nw = make_node(name).capacity(
+        cpu="32", memory="64Gi", pods=20, **{"nvidia_com__gpu": 8}
+    )
+    nw.label(GROUPS_LABEL, groups)
+    return nw.obj()
+
+
+class TestFilterAndReserve:
+    def test_filter_rejects_fragmented_groups(self):
+        plugin = NodeResourcesNumaAligned()
+        ni = NodeInfo(_gpu_node("n"))
+        # two pods holding 3 GPUs in each group: 1+1 free, no group fits 2
+        for g in (0, 1):
+            p = _gpu_pod(f"held{g}", 3)
+            p.metadata.annotations[ASSIGNED_ANNOTATION] = str(g)
+            ni.add_pod(p)
+        assert group_free(ni, "nvidia.com/gpu") == [1, 1]
+        st = plugin.filter(CycleState(), _gpu_pod("w", 2), ni)
+        assert st is not None and not st.is_success()
+        # an unaligned 2-GPU pod is untouched by the plugin
+        assert plugin.filter(CycleState(), _gpu_pod("w2", 2, aligned=False), ni) is None
+
+    def test_filter_rejects_unlabeled_node(self):
+        plugin = NodeResourcesNumaAligned()
+        node = _gpu_node("n")
+        del node.metadata.labels[GROUPS_LABEL]
+        st = plugin.filter(CycleState(), _gpu_pod("w", 2), NodeInfo(node))
+        assert st is not None and not st.is_success()
+
+
+class TestE2EAlignment:
+    def test_group_capacity_never_exceeded(self):
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(client, informers, batch=True, max_batch=64)
+        for i in range(6):
+            client.create_node(_gpu_node(f"n{i}"))
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        # 24 aligned 2-GPU pods exactly fill 6 nodes x 2 groups x 4 GPUs
+        for i in range(24):
+            client.create_pod(_gpu_pod(f"g{i}", 2))
+        sched.start()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            pods, _ = client.list_pods()
+            if sum(1 for p in pods if p.spec.node_name) >= 24:
+                break
+            time.sleep(0.05)
+        sched.wait_for_inflight_binds()
+        pods, _ = client.list_pods()
+        bound = [p for p in pods if p.spec.node_name]
+        assert len(bound) == 24
+        # invariant: per (node, group) GPU usage <= 4
+        usage = {}
+        for p in bound:
+            g = p.metadata.annotations[ASSIGNED_ANNOTATION]
+            key = (p.spec.node_name, g)
+            usage[key] = usage.get(key, 0) + 2
+        assert all(v <= 4 for v in usage.values()), usage
+        sched.stop()
+        informers.stop()
+
+    def test_misaligned_excess_pod_stays_pending(self):
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(client, informers, batch=True, max_batch=64)
+        client.create_node(_gpu_node("only", groups="3_5"))
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        # 5-aligned fits only group 1; a second 4-GPU pod can't align
+        client.create_pod(_gpu_pod("big", 5))
+        sched.start()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            pods, _ = client.list_pods()
+            if any(p.spec.node_name for p in pods):
+                break
+            time.sleep(0.05)
+        client.create_pod(_gpu_pod("second", 4))
+        deadline = time.time() + 15
+        cond = False
+        while time.time() < deadline:
+            try:
+                p2 = client.get_pod("default", "second")
+            except KeyError:
+                break
+            if p2.spec.node_name:
+                raise AssertionError("4-GPU pod cannot align on 3_5 node")
+            if any(
+                c.type == "PodScheduled" and c.status == "False"
+                for c in p2.status.conditions
+            ):
+                cond = True
+                break
+            time.sleep(0.05)
+        assert cond
+        sched.stop()
+        informers.stop()
