@@ -570,11 +570,20 @@ def solve_packed(
         n_cap, r_dims = alloc_in.shape
     else:
         n_cap, r_dims = next(s for n, s, _ in layout if n == "alloc")
+    u_rows = next((s for n, s, _ in layout if n == "rows"), (8,))[0]
+    # basic-kernel VMEM estimate, calibrated against the compiler's
+    # scoped-vmem accounting (measured 22.69M at n=51200, u=8: the
+    # fused kernel + its pipeline buffers cost ~(10R + 3U + 30) rows of
+    # 4 bytes per node); past the budget the XLA scan takes over
+    basic_vmem_ok = (
+        4 * n_cap * (10 * r_dims + 3 * u_rows + 30) <= 14 * (1 << 20)
+    )
     use_pallas = (
         mode in ("greedy", "constrained")
         and _os.environ.get("KTPU_PALLAS", "1") != "0"
         and jax.default_backend() == "tpu"
         and (b <= 1024 or b % 1024 == 0)
+        and (mode == "constrained" or basic_vmem_ok)
     )
     caps = None
     if mode == "constrained" and use_pallas:

@@ -33,6 +33,8 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
+import dataclasses
+
 from kubernetes_tpu.api.types import Pod, pod_resource_requests
 from kubernetes_tpu.cache.node_info import NodeInfo
 from kubernetes_tpu.framework.interface import CycleState, Plugin, Status
@@ -69,14 +71,22 @@ def group_free(
 ) -> Optional[List[int]]:
     """Free devices per NUMA group: label capacities minus the recorded
     group assignments of the node's pods (assumed pods included -- they
-    are in NodeInfo.pods)."""
+    are in NodeInfo.pods). Devices held by UNALIGNED pods have no known
+    group, so they are subtracted from EVERY group -- pessimistic, but
+    the only direction that keeps the "aligned pods never bounce"
+    guarantee on mixed nodes (the kubelet may have scattered them
+    anywhere)."""
     groups = _node_groups(node_info)
     if groups is None:
         return None
     free = list(groups)
+    unattributed = 0
     for p in node_info.pods:
         g = p.metadata.annotations.get(ASSIGNED_ANNOTATION)
         if g is None:
+            unattributed += int(
+                pod_resource_requests(p).get(resource, 0)
+            )
             continue
         try:
             gi = int(g)
@@ -84,6 +94,8 @@ def group_free(
             continue
         if 0 <= gi < len(free):
             free[gi] -= _aligned_request(p, resource)
+    if unattributed:
+        free = [f - unattributed for f in free]
     return free
 
 
@@ -176,11 +188,20 @@ class NodeResourcesNumaAligned(Plugin):
             return Status.unschedulable(
                 f"no NUMA group with {want} free {res}"
             )
-        # local write first (in-flight filters read the assumed clone's
-        # shared annotations dict), then a durable API write so the
-        # assignment survives stores that copy objects -- the shared-dict
-        # aliasing alone is an accident of the in-proc server
-        pod.metadata.annotations[ASSIGNED_ANNOTATION] = str(gi)
+        # local write on a REPLACED metadata object: the assumed
+        # clone's metadata dict is shared with the informer-cache/store
+        # object and is contractually read-only (types.py assumed_clone),
+        # so the clone gets its own copy carrying the assignment (the
+        # cache's NodeInfo holds the clone -> in-flight filters see it)
+        # and the durable API write below updates the stored object
+        # through the store's own copy-on-write path
+        pod.metadata = dataclasses.replace(
+            pod.metadata,
+            annotations={
+                **pod.metadata.annotations,
+                ASSIGNED_ANNOTATION: str(gi),
+            },
+        )
         client = getattr(self._handle, "client", None)
         if client is not None:
             try:
@@ -198,7 +219,12 @@ class NodeResourcesNumaAligned(Plugin):
     def unreserve(
         self, state: CycleState, pod: Pod, node_name: str
     ) -> None:
-        pod.metadata.annotations.pop(ASSIGNED_ANNOTATION, None)
+        if ASSIGNED_ANNOTATION in pod.metadata.annotations:
+            ann = dict(pod.metadata.annotations)
+            ann.pop(ASSIGNED_ANNOTATION, None)
+            pod.metadata = dataclasses.replace(
+                pod.metadata, annotations=ann
+            )
         client = getattr(self._handle, "client", None)
         if client is not None:
             try:

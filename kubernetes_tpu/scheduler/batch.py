@@ -90,6 +90,14 @@ except Exception:  # noqa: BLE001 - pure-Python fallback
 logger = logging.getLogger(__name__)
 
 POD_BUCKET = 64  # batch padded to a multiple of this to bound re-JITs
+#: constrained batches above this node capacity take the sequential host
+#: path: the XLA constrained scan's compile at >32k nodes runs for
+#: minutes (long enough to trip the serving link's dead-man timer and
+#: wedge the device), and the fused kernel's VMEM gate already excludes
+#: these shapes. The 50k-node regime is a plain-pod churn workload
+#: (BASELINE #5); constrained families at that scale are out of the
+#: supported envelope, like the reference's adaptive sampling regime.
+CONSTRAINED_NODE_CAP = 32768
 MASK_ROW_BUCKET = 8  # dedup static-mask rows padded to a multiple of this
 MAX_INFLIGHT = 3  # solver batches in flight between dispatcher and committer
 
@@ -935,6 +943,13 @@ class BatchScheduler(Scheduler):
             or affinity is not None
             or score_batch is not None
         )
+        if constrained and nt.capacity > CONSTRAINED_NODE_CAP:
+            self._drain_pending()
+            self.envelope_fallbacks += 1
+            for pi in solver_infos:
+                self.pods_fallback += 1
+                self.attempt_schedule(pi)
+            return None
         if self.mesh is None:
             # single-buffer upload: over the serving link every device_put
             # operand pays its own round trip (~40-90ms each); the whole
@@ -1775,6 +1790,8 @@ class BatchScheduler(Scheduler):
             )
             jax.block_until_ready(out)
         else:
+            if n > CONSTRAINED_NODE_CAP:
+                return  # constrained batches route to the host path
             # compile the packed constrained layouts the run loop can hit
             # (cold / carry-refresh / steady), mirroring the basic-path
             # variants above -- a first constrained batch must not pay a

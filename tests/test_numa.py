@@ -133,3 +133,51 @@ class TestE2EAlignment:
         assert cond
         sched.stop()
         informers.stop()
+
+
+class TestFragmentationDiscriminates:
+    def test_fragmented_node_rejected_despite_total_capacity(self):
+        """The alignment-discriminating shape: total free devices would
+        fit the pod, but no single group does -- only the NUMA filter
+        can reject this (plain resource fit would pass)."""
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(client, informers, batch=True, max_batch=64)
+        client.create_node(_gpu_node("frag", groups="4_4"))
+        # a second node with one whole free group: the aligned pod must
+        # land HERE, not on the fragmented node
+        client.create_node(_gpu_node("roomy", groups="4_4"))
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        # fragment node "frag": 3 GPUs held in EACH group (2 free total,
+        # 1+1 split); fill one roomy group too
+        holders = []
+        for node, g, gpus in (
+            ("frag", 0, 3), ("frag", 1, 3), ("roomy", 0, 4),
+        ):
+            p = _gpu_pod(f"h-{node}-{g}", gpus)
+            p.spec.node_name = node
+            p.metadata.annotations[ASSIGNED_ANNOTATION] = str(g)
+            holders.append(p)
+            client.create_pod(p)
+        sched.start()
+        client.create_pod(_gpu_pod("want2", 2))
+        deadline = time.time() + 30
+        placed = None
+        while time.time() < deadline:
+            try:
+                w = client.get_pod("default", "want2")
+            except KeyError:
+                break
+            if w.spec.node_name:
+                placed = w
+                break
+            time.sleep(0.05)
+        assert placed is not None and placed.spec.node_name == "roomy", (
+            placed.spec.node_name if placed else "never bound"
+        )
+        assert placed.metadata.annotations[ASSIGNED_ANNOTATION] == "1"
+        sched.stop()
+        informers.stop()
