@@ -58,6 +58,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="YAML manifest(s) of Pods/Nodes/PDBs/PodGroups/Services to "
         "create at boot (the in-proc control plane's seed state)",
     )
+    ap.add_argument(
+        "--fault-profile", default="",
+        help="named fault-injection profile (chaos runs; see "
+        "kubernetes_tpu/robustness/faults.py builtin_profiles)",
+    )
+    ap.add_argument(
+        "--fault-seed", type=int, default=0,
+        help="seed for the fault-injection RNG streams",
+    )
     ap.add_argument("-v", "--verbose", action="count", default=0)
     return ap
 
@@ -103,6 +112,21 @@ def main(argv=None) -> int:
         gates.set_from_map(overrides)
     except ValueError as e:
         raise SystemExit(f"--feature-gates: {e}") from None
+
+    if args.fault_profile:
+        from kubernetes_tpu.robustness.faults import (
+            FaultInjector,
+            install_injector,
+            load_profile,
+        )
+
+        try:
+            profile = load_profile(
+                args.fault_profile, seed=args.fault_seed
+            )
+        except KeyError as e:
+            raise SystemExit(f"--fault-profile: {e.args[0]}") from None
+        install_injector(FaultInjector(profile))
 
     app = SchedulerApp(
         config=cfg, batch=gates.enabled("TPUBatchSolver")

@@ -13,9 +13,13 @@ Two drive modes:
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from kubernetes_tpu.robustness.faults import FaultPoint, get_injector
+from kubernetes_tpu.utils import metrics
 from kubernetes_tpu.utils import timeline as _timeline
 
 from kubernetes_tpu.apiserver.server import (
@@ -26,6 +30,13 @@ from kubernetes_tpu.apiserver.server import (
     Watch,
     WatchEvent,
 )
+
+logger = logging.getLogger(__name__)
+
+
+class WatchDropped(Exception):
+    """The watch stream broke (server-side compaction, network, injected
+    drop); the informer must relist."""
 
 
 class ResourceEventHandler:
@@ -85,6 +96,7 @@ class Informer:
         self._watch: Optional[Watch] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._needs_relist = False
         self.synced = False
 
     def add_event_handler(self, handler: ResourceEventHandler) -> None:
@@ -153,11 +165,83 @@ class Informer:
                 for etype, old, obj in dispatch:
                     h.handle(etype, old, obj)
 
+    def _relist(self) -> None:
+        """Relist-on-watch-error (reference Reflector ListAndWatch
+        :207 relist semantics): re-list the kind, open a fresh watch
+        from the listed RV, diff the fresh state against the local
+        store, and dispatch synthetic ADDED/MODIFIED/DELETED events so
+        every handler (cache, queue) converges -- no event is silently
+        lost across the gap."""
+        metrics.watch_relists.inc(kind=self.kind)
+        logger.warning("watch for %s broke; relisting", self.kind)
+        if self._watch is not None:
+            try:
+                self._watch.stop()
+            except Exception:  # noqa: BLE001 - old stream is already dead
+                pass
+        objs, rv = self._server.list(self.kind)
+        self._watch = self._server.watch(self.kind, since_rv=rv)
+        dispatch = []
+        with self._lock:
+            fresh = {
+                (o.metadata.namespace, o.metadata.name): o for o in objs
+            }
+            for key, old in self._store.items():
+                if key not in fresh:
+                    dispatch.append((DELETED, None, old))
+            for key, obj in fresh.items():
+                old = self._store.get(key)
+                if old is None:
+                    dispatch.append((ADDED, None, obj))
+                elif (
+                    old.metadata.resource_version
+                    != obj.metadata.resource_version
+                ):
+                    dispatch.append((MODIFIED, old, obj))
+            self._store = fresh
+        self._dispatch(dispatch)
+
+    def _next_events(self, timeout: Optional[float]) -> List[WatchEvent]:
+        """One read from the watch stream, with the injected-drop seam
+        and real stream errors both converted into a relist."""
+        if self._needs_relist:
+            # a previous relist failed (server down mid-recovery); the
+            # old watch is already stopped and returns [] without
+            # raising, so the retry must happen HERE or the informer
+            # would be silently stranded forever
+            if not self._try_relist(timeout):
+                return []
+        inj = get_injector()
+        try:
+            if inj is not None and inj.should_fire(FaultPoint.WATCH_DROP):
+                raise WatchDropped(self.kind)
+            if timeout is None:
+                return self._watch.pending()
+            return self._watch.next_batch(timeout=timeout)
+        except Exception:  # noqa: BLE001 - any stream failure => relist
+            self._try_relist(timeout)
+            return []
+
+    def _try_relist(self, timeout: Optional[float]) -> bool:
+        """Attempt a relist; on failure arm the retry flag (and, on the
+        threaded path, back off briefly so a dead server isn't
+        busy-spun)."""
+        try:
+            self._relist()
+        except Exception:  # noqa: BLE001 - server also down: retry later
+            logger.exception("relist for %s failed; will retry", self.kind)
+            self._needs_relist = True
+            if timeout is not None:
+                time.sleep(min(timeout, 0.1))
+            return False
+        self._needs_relist = False
+        return True
+
     def pump(self) -> int:
         """Synchronously process pending events; returns count."""
         if self._watch is None:
             self._list_and_start_watch()
-        evs = self._watch.pending()
+        evs = self._next_events(None)
         self._apply_batch(evs)
         return len(evs)
 
@@ -169,7 +253,7 @@ class Informer:
 
         def run() -> None:
             while not self._stop.is_set():
-                evs = self._watch.next_batch(timeout=0.1)
+                evs = self._next_events(0.1)
                 if evs:
                     self._apply_batch(evs)
 

@@ -14,12 +14,15 @@ from typing import Any, Dict, List, Optional
 import yaml
 
 from kubernetes_tpu.config.types import (
+    FaultInjectionConfiguration,
+    FaultPointConfiguration,
     KubeSchedulerConfiguration,
     KubeSchedulerProfile,
     LeaderElectionConfiguration,
     Plugin,
     PluginSet,
     Plugins,
+    RobustnessConfiguration,
     TPUSolverConfiguration,
 )
 from kubernetes_tpu.scheduler.extender import ExtenderConfig
@@ -147,6 +150,39 @@ def load_config_from_dict(raw: Dict[str, Any]) -> KubeSchedulerConfiguration:
         mesh_devices=int(solver_raw.get("meshDevices", 0)),
     )
     cfg.extenders = [_extender(e) for e in raw.get("extenders", [])]
+    rb_raw = raw.get("robustness", {})
+    cfg.robustness = RobustnessConfiguration(
+        enabled=bool(rb_raw.get("enabled", True)),
+        solve_timeout_seconds=_duration_seconds(
+            rb_raw.get("solveTimeout", 60.0)
+        ),
+        failure_threshold=int(rb_raw.get("failureThreshold", 3)),
+        cooloff_seconds=_duration_seconds(rb_raw.get("cooloff", 5.0)),
+        probe_batches=int(rb_raw.get("probeBatches", 1)),
+        retry_max_attempts=int(rb_raw.get("retryMaxAttempts", 2)),
+        retry_backoff_seconds=_duration_seconds(
+            rb_raw.get("retryBackoff", 0.05)
+        ),
+        retry_max_backoff_seconds=_duration_seconds(
+            rb_raw.get("retryMaxBackoff", 1.0)
+        ),
+    )
+    fi_raw = raw.get("faultInjection", {})
+    cfg.fault_injection = FaultInjectionConfiguration(
+        enabled=bool(fi_raw.get("enabled", False)),
+        profile=fi_raw.get("profile", ""),
+        seed=int(fi_raw.get("seed", 0)),
+        points={
+            name: FaultPointConfiguration(
+                rate=float(p.get("rate", 0.0)),
+                max_fires=(
+                    int(p["maxFires"]) if "maxFires" in p else None
+                ),
+                hang_seconds=_duration_seconds(p.get("hangSeconds", 0.0)),
+            )
+            for name, p in fi_raw.get("points", {}).items()
+        },
+    )
     return cfg
 
 

@@ -117,6 +117,43 @@ class TPUSolverConfiguration:
 
 
 @dataclass
+class RobustnessConfiguration:
+    """Degradation-ladder knobs (robustness/ladder.py): per-tier circuit
+    breakers, device-solve watchdog, solve/bind retry policy."""
+
+    enabled: bool = True
+    solve_timeout_seconds: float = 60.0  # device-solve wall-clock deadline
+    failure_threshold: int = 3  # consecutive failures before open
+    cooloff_seconds: float = 5.0  # open -> half-open delay
+    probe_batches: int = 1  # half-open probes before close
+    retry_max_attempts: int = 2
+    retry_backoff_seconds: float = 0.05
+    retry_max_backoff_seconds: float = 1.0
+
+
+@dataclass
+class FaultPointConfiguration:
+    """One injection point's firing policy (robustness/faults.py)."""
+
+    rate: float = 0.0
+    max_fires: Optional[int] = None
+    hang_seconds: float = 0.0
+
+
+@dataclass
+class FaultInjectionConfiguration:
+    """Fault-injection harness config. Off by default: production pays a
+    single is-None check per seam. ``profile`` names a builtin profile
+    (robustness/faults.py builtin_profiles); ``points`` overrides or
+    extends its per-point rates."""
+
+    enabled: bool = False
+    profile: str = ""
+    seed: int = 0
+    points: Dict[str, FaultPointConfiguration] = field(default_factory=dict)
+
+
+@dataclass
 class KubeSchedulerConfiguration:
     """types.go:46."""
 
@@ -132,4 +169,10 @@ class KubeSchedulerConfiguration:
     feature_gates: Dict[str, bool] = field(default_factory=dict)
     tpu_solver: TPUSolverConfiguration = field(
         default_factory=TPUSolverConfiguration
+    )
+    robustness: RobustnessConfiguration = field(
+        default_factory=RobustnessConfiguration
+    )
+    fault_injection: FaultInjectionConfiguration = field(
+        default_factory=FaultInjectionConfiguration
     )

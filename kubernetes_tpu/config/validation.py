@@ -91,6 +91,49 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
         errors.append("tpuSolver.batchWindow must be >= 0")
     if ts.mesh_devices < 0:
         errors.append("tpuSolver.meshDevices must be >= 0")
+
+    rb = getattr(cfg, "robustness", None)
+    if rb is not None:
+        if rb.solve_timeout_seconds < 0:
+            errors.append("robustness.solveTimeout must be >= 0")
+        if rb.failure_threshold < 1:
+            errors.append("robustness.failureThreshold must be >= 1")
+        if rb.cooloff_seconds < 0:
+            errors.append("robustness.cooloff must be >= 0")
+        if rb.probe_batches < 1:
+            errors.append("robustness.probeBatches must be >= 1")
+        if rb.retry_max_attempts < 1:
+            errors.append("robustness.retryMaxAttempts must be >= 1")
+        if rb.retry_backoff_seconds < 0:
+            errors.append("robustness.retryBackoff must be >= 0")
+
+    fi = getattr(cfg, "fault_injection", None)
+    if fi is not None and fi.enabled:
+        from kubernetes_tpu.robustness.faults import (
+            FaultPoint,
+            builtin_profiles,
+        )
+
+        if fi.profile and fi.profile not in builtin_profiles():
+            errors.append(
+                f"faultInjection.profile {fi.profile!r} is not a known "
+                f"profile ({', '.join(sorted(builtin_profiles()))})"
+            )
+        for name, p in fi.points.items():
+            if name not in FaultPoint.ALL:
+                errors.append(
+                    f"faultInjection.points.{name} is not an injection "
+                    f"point ({', '.join(FaultPoint.ALL)})"
+                )
+            if not 0.0 <= p.rate <= 1.0:
+                errors.append(
+                    f"faultInjection.points.{name}.rate must be in [0, 1]"
+                )
+            if p.hang_seconds < 0:
+                errors.append(
+                    f"faultInjection.points.{name}.hangSeconds must be "
+                    f">= 0"
+                )
     return errors
 
 

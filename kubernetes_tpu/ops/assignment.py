@@ -541,6 +541,31 @@ def _constrained_caps(pieces_by_name):
     )
 
 
+def pallas_candidate(
+    mode: str, b: int, n_cap: int, r_dims: int, u_rows: int
+) -> bool:
+    """Whether solve_packed would attempt the fused Pallas kernel for
+    this (mode, shape): backend + env gate, the kernel's batch-shape
+    tiling constraint, and the basic kernel's VMEM estimate (calibrated
+    against the compiler's scoped-vmem accounting: the fused kernel +
+    pipeline buffers cost ~(10R + 3U + 30) rows of 4 bytes per node).
+    The constrained kernel's exact per-family VMEM estimate may still
+    downgrade inside solve_packed. Shared with the degradation ladder
+    (scheduler/batch.py _device_tiers) so a shape that would never run
+    the kernel never gets a 'pallas' tier attempt -- failures charge the
+    tier that actually executed."""
+    basic_vmem_ok = (
+        4 * n_cap * (10 * r_dims + 3 * u_rows + 30) <= 14 * (1 << 20)
+    )
+    return (
+        mode in ("greedy", "constrained")
+        and _os.environ.get("KTPU_PALLAS", "1") != "0"
+        and jax.default_backend() == "tpu"
+        and (b <= 1024 or b % 1024 == 0)
+        and (mode == "constrained" or basic_vmem_ok)
+    )
+
+
 def solve_packed(
     pieces,  # ordered [(name, ndarray)] to ride the buffer
     alloc_in,
@@ -549,6 +574,7 @@ def solve_packed(
     nzr_in,
     config: GreedyConfig = GreedyConfig(),
     mode: str = "greedy",
+    allow_pallas: bool = True,
 ):
     """Host-side companion of _solve_packed_jit: concatenates the pieces
     (int32 / bool / float32 -- see _solve_packed_jit's kind codes) and
@@ -571,19 +597,10 @@ def solve_packed(
     else:
         n_cap, r_dims = next(s for n, s, _ in layout if n == "alloc")
     u_rows = next((s for n, s, _ in layout if n == "rows"), (8,))[0]
-    # basic-kernel VMEM estimate, calibrated against the compiler's
-    # scoped-vmem accounting (measured 22.69M at n=51200, u=8: the
-    # fused kernel + its pipeline buffers cost ~(10R + 3U + 30) rows of
-    # 4 bytes per node); past the budget the XLA scan takes over
-    basic_vmem_ok = (
-        4 * n_cap * (10 * r_dims + 3 * u_rows + 30) <= 14 * (1 << 20)
-    )
     use_pallas = (
-        mode in ("greedy", "constrained")
-        and _os.environ.get("KTPU_PALLAS", "1") != "0"
-        and jax.default_backend() == "tpu"
-        and (b <= 1024 or b % 1024 == 0)
-        and (mode == "constrained" or basic_vmem_ok)
+        allow_pallas  # the degradation ladder's xla tier forces this off
+        # when the pallas breaker is open (robustness/ladder.py)
+        and pallas_candidate(mode, b, n_cap, r_dims, u_rows)
     )
     caps = None
     if mode == "constrained" and use_pallas:

@@ -229,6 +229,11 @@ class NodeResourcesNumaAligned(Plugin):
         if client is not None:
             try:
                 def clear_group(p: Pod) -> None:
+                    # never strip a BOUND pod: a stale re-attempt's
+                    # unreserve must not destroy the live placement's
+                    # group assignment (written by the attempt that won)
+                    if p.spec.node_name:
+                        return
                     p.metadata.annotations.pop(ASSIGNED_ANNOTATION, None)
 
                 client.server.guaranteed_update(

@@ -1,0 +1,62 @@
+"""Robustness subsystem: fault injection + solver degradation ladder.
+
+The scheduler's availability contract (Borg/Omega, PAPERS.md): placement
+quality may degrade, the control loop never stops. This package provides
+
+- ``faults``: a deterministic, seedable fault-injection harness with
+  named injection points wired through the device scheduling path
+  (device solve raises / hangs / returns garbage, bind conflicts, watch
+  stream drops). Off by default; production pays ~zero overhead.
+- ``circuit``: per-solver-tier circuit breakers (closed -> open ->
+  half-open with probe batches), retry-with-exponential-backoff, and a
+  wall-clock watchdog for device solves.
+- ``ladder``: the degradation ladder Pallas -> XLA scan -> host greedy
+  -> sequential oracle, with the host-greedy numpy solver.
+
+Integration points: scheduler/batch.py (solve path), scheduler/
+scheduler.py (bind retry), client/informer.py (relist on watch error).
+"""
+
+from kubernetes_tpu.robustness.circuit import (
+    BreakerOpen,
+    CircuitBreaker,
+    RetryPolicy,
+    SolveTimeout,
+    Watchdog,
+)
+from kubernetes_tpu.robustness.faults import (
+    FaultInjected,
+    FaultInjector,
+    FaultPoint,
+    get_injector,
+    install_injector,
+)
+from kubernetes_tpu.robustness.ladder import (
+    RobustnessConfig,
+    SolverLadder,
+    TIER_HOST_GREEDY,
+    TIER_PALLAS,
+    TIER_SEQUENTIAL,
+    TIER_XLA,
+    host_greedy_assign,
+)
+
+__all__ = [
+    "BreakerOpen",
+    "CircuitBreaker",
+    "FaultInjected",
+    "FaultInjector",
+    "FaultPoint",
+    "RetryPolicy",
+    "RobustnessConfig",
+    "SolveTimeout",
+    "SolverLadder",
+    "TIER_HOST_GREEDY",
+    "TIER_PALLAS",
+    "TIER_SEQUENTIAL",
+    "TIER_XLA",
+    "Watchdog",
+    "get_injector",
+    "host_greedy_assign",
+    "install_injector",
+]

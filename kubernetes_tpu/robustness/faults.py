@@ -1,0 +1,246 @@
+"""Deterministic, seedable fault injection for the device scheduling
+path.
+
+Named injection points sit on the seams the bench history has actually
+seen fail (compile blowups, the serving-link dead-man timer, bind
+conflicts under churn, dropped watch streams). Each point fires with a
+configured probability from its OWN seeded RNG stream, so a chaos run is
+reproducible regardless of thread interleaving: the k-th evaluation of a
+given point always makes the same decision for a given seed.
+
+Production wiring: ``get_injector()`` returns None unless a harness (a
+chaos test, ``bench.py --fault-profile``, ``python -m kubernetes_tpu
+--fault-profile``) installed one -- the hot path pays a single ``is not
+None`` check per seam.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from kubernetes_tpu.utils import metrics
+
+
+class FaultPoint:
+    """Injection point names (the seams in the scheduling path)."""
+
+    #: device solve raises mid-dispatch (compile blowup, Mosaic lowering
+    #: failure, serving-link error)
+    DEVICE_SOLVE = "device_solve"
+    #: device solve blocks past the wall-clock watchdog deadline (the
+    #: serving-link dead-man-timer wedge)
+    DEVICE_SOLVE_HANG = "device_solve_hang"
+    #: solve "succeeds" but the downloaded assignments are garbage
+    #: (NaN-score argmax artifacts, out-of-range node indices)
+    SOLVE_GARBAGE = "solve_garbage"
+    #: bind/commit transaction returns a conflict error
+    BIND_CONFLICT = "bind_conflict"
+    #: watch stream drops mid-frame (informer must relist)
+    WATCH_DROP = "watch_drop"
+
+    ALL = (
+        DEVICE_SOLVE, DEVICE_SOLVE_HANG, SOLVE_GARBAGE, BIND_CONFLICT,
+        WATCH_DROP,
+    )
+
+
+class FaultInjected(Exception):
+    """Raised by a firing injection point (subsystems under test treat it
+    like the real failure it simulates)."""
+
+    def __init__(self, point: str) -> None:
+        super().__init__(f"injected fault at {point!r}")
+        self.point = point
+
+
+@dataclass
+class PointConfig:
+    """Per-point firing policy."""
+
+    rate: float = 0.0  # probability per evaluation, [0, 1]
+    max_fires: Optional[int] = None  # stop firing after this many (None =
+    # unlimited) -- lets a chaos run model a transient failure burst that
+    # heals, which is what drives a breaker through a full
+    # open -> half-open -> closed cycle
+    hang_seconds: float = 0.0  # DEVICE_SOLVE_HANG: how long to block
+
+
+@dataclass
+class FaultProfile:
+    """A named, loadable set of point configs (bench --fault-profile)."""
+
+    name: str
+    seed: int = 0
+    points: Dict[str, PointConfig] = field(default_factory=dict)
+
+
+class FaultInjector:
+    """Deterministic injector: one seeded RNG stream per point.
+
+    ``should_fire(point)`` consumes one draw from that point's stream;
+    determinism holds per point even when several threads hit different
+    points concurrently (each stream has its own lock).
+    """
+
+    def __init__(self, profile: FaultProfile) -> None:
+        self.profile = profile
+        self._rngs: Dict[str, random.Random] = {}
+        self._fired: Dict[str, int] = {}
+        self._evals: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        for i, point in enumerate(FaultPoint.ALL):
+            # independent per-point streams from the one profile seed
+            # (int-derived: str/tuple seeding hashes with the per-process
+            # salt and would break cross-run determinism)
+            self._rngs[point] = random.Random(profile.seed * 1000003 + i)
+            self._fired[point] = 0
+            self._evals[point] = 0
+
+    def point_config(self, point: str) -> Optional[PointConfig]:
+        return self.profile.points.get(point)
+
+    def should_fire(self, point: str) -> bool:
+        cfg = self.profile.points.get(point)
+        if cfg is None or cfg.rate <= 0.0:
+            return False
+        with self._lock:
+            self._evals[point] += 1
+            if cfg.max_fires is not None and self._fired[point] >= cfg.max_fires:
+                return False
+            fire = self._rngs[point].random() < cfg.rate
+            if fire:
+                self._fired[point] += 1
+        if fire:
+            metrics.faults_injected.inc(point=point)
+        return fire
+
+    def fired_count(self, point: str) -> int:
+        with self._lock:
+            return self._fired.get(point, 0)
+
+    def eval_count(self, point: str) -> int:
+        with self._lock:
+            return self._evals.get(point, 0)
+
+    # -- seam helpers (what the integration points actually call) -------
+
+    def raise_maybe(self, point: str) -> None:
+        """Raise FaultInjected when the point fires."""
+        if self.should_fire(point):
+            raise FaultInjected(point)
+
+    def hang_seconds_maybe(self, point: str) -> float:
+        """Seconds the seam should block for (0.0 = no fault). The caller
+        sleeps inside whatever watchdog scope guards the real operation,
+        so the injected hang trips the same timeout the real wedge
+        would."""
+        if self.should_fire(point):
+            cfg = self.profile.points.get(point)
+            return cfg.hang_seconds if cfg is not None else 0.0
+        return 0.0
+
+    def corrupt_assignments_maybe(self, point: str, assignments):
+        """Return a corrupted copy of a downloaded assignment vector when
+        the point fires (out-of-range node indices -- the downstream
+        validator must catch exactly this shape of garbage)."""
+        if not self.should_fire(point):
+            return assignments
+        out = assignments.copy()
+        if out.size:
+            # deterministic corruption: poison every 3rd slot with an
+            # out-of-range index and the first slot with a huge negative
+            out[::3] = 1 << 30
+            out[0] = -(1 << 30)
+        return out
+
+
+# -- global install point ------------------------------------------------
+
+_injector: Optional[FaultInjector] = None
+
+
+def install_injector(injector: Optional[FaultInjector]) -> None:
+    """Install (or clear, with None) the process-wide injector."""
+    global _injector
+    _injector = injector
+
+
+def get_injector() -> Optional[FaultInjector]:
+    return _injector
+
+
+# -- named profiles (bench.py --fault-profile / chaos suite) -------------
+
+def builtin_profiles() -> Dict[str, FaultProfile]:
+    """The named injection profiles the harness ships. ``seed`` can be
+    overridden after load (faults.seed config knob)."""
+    return {
+        # ISSUE acceptance shape: 20% device-solve failures + forced
+        # solve timeouts + one bind-conflict burst, healing after a
+        # bounded number of fires so breakers complete a full cycle
+        "chaos-default": FaultProfile(
+            name="chaos-default",
+            seed=0,
+            points={
+                FaultPoint.DEVICE_SOLVE: PointConfig(rate=0.2, max_fires=24),
+                FaultPoint.DEVICE_SOLVE_HANG: PointConfig(
+                    rate=0.1, max_fires=6, hang_seconds=1.0
+                ),
+                FaultPoint.BIND_CONFLICT: PointConfig(rate=1.0, max_fires=3),
+            },
+        ),
+        # every device solve fails: exercises the floor of the ladder
+        "device-down": FaultProfile(
+            name="device-down",
+            seed=0,
+            points={FaultPoint.DEVICE_SOLVE: PointConfig(rate=1.0)},
+        ),
+        # garbage results: exercises download validation + host re-solve
+        "garbage-scores": FaultProfile(
+            name="garbage-scores",
+            seed=0,
+            points={FaultPoint.SOLVE_GARBAGE: PointConfig(rate=0.25)},
+        ),
+        # flaky watch: exercises informer relist
+        "flaky-watch": FaultProfile(
+            name="flaky-watch",
+            seed=0,
+            points={FaultPoint.WATCH_DROP: PointConfig(rate=0.05)},
+        ),
+    }
+
+
+def injector_from_configuration(cfg) -> Optional[FaultInjector]:
+    """Build an injector from the wire-config block
+    (config.types.FaultInjectionConfiguration); None when disabled.
+    Named-profile points load first, then per-point overrides."""
+    if not cfg.enabled:
+        return None
+    points: Dict[str, PointConfig] = {}
+    if cfg.profile:
+        points.update(load_profile(cfg.profile).points)
+    for name, p in cfg.points.items():
+        points[name] = PointConfig(
+            rate=p.rate, max_fires=p.max_fires, hang_seconds=p.hang_seconds
+        )
+    return FaultInjector(
+        FaultProfile(
+            name=cfg.profile or "custom", seed=cfg.seed, points=points
+        )
+    )
+
+
+def load_profile(name: str, seed: Optional[int] = None) -> FaultProfile:
+    profiles = builtin_profiles()
+    if name not in profiles:
+        raise KeyError(
+            f"unknown fault profile {name!r} (known: "
+            f"{', '.join(sorted(profiles))})"
+        )
+    profile = profiles[name]
+    if seed is not None:
+        profile.seed = seed
+    return profile

@@ -78,6 +78,12 @@ class SchedulerApp:
         self.client = Client(self.server)
         self.informers = InformerFactory(self.server)
         self.identity = f"scheduler-{uuid.uuid4().hex[:8]}"
+        from kubernetes_tpu.robustness.faults import (
+            injector_from_configuration,
+            install_injector,
+        )
+        from kubernetes_tpu.robustness.ladder import RobustnessConfig
+
         self.sched: Scheduler = new_scheduler(
             self.client,
             self.informers,
@@ -87,7 +93,13 @@ class SchedulerApp:
             ),
             batch=batch,
             extenders=getattr(self.config, "extenders", None),
+            robustness_config=RobustnessConfig.from_configuration(
+                self.config.robustness
+            ),
         )
+        injector = injector_from_configuration(self.config.fault_injection)
+        if injector is not None:
+            install_injector(injector)
         self.debugger = CacheDebugger(
             self.client,
             self.sched.cache,

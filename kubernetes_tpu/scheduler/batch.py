@@ -76,6 +76,18 @@ from kubernetes_tpu.ops.topology import (
     pack_spread_batch,
     pad_spread_tensors,
 )
+from kubernetes_tpu.robustness.circuit import SolveTimeout
+from kubernetes_tpu.robustness.faults import FaultPoint, get_injector
+from kubernetes_tpu.robustness.ladder import (
+    LadderExhausted,
+    RobustnessConfig,
+    SolverLadder,
+    TIER_HOST_GREEDY,
+    TIER_PALLAS,
+    TIER_SEQUENTIAL,
+    TIER_XLA,
+    host_greedy_assign,
+)
 from kubernetes_tpu.scheduler.generic import SNAPSHOT_STATE_KEY
 from kubernetes_tpu.scheduler.scheduler import Scheduler
 from kubernetes_tpu.tensors import NodeTensorCache, pack_pod_batch
@@ -196,6 +208,7 @@ class BatchScheduler(Scheduler):
         batch_window: float = 0.01,
         solver_mode: str = "greedy",
         mesh=None,
+        robustness_config: Optional[RobustnessConfig] = None,
         **kwargs,
     ) -> None:
         """``solver_mode``: "greedy" replays the sequential argmax exactly
@@ -255,6 +268,18 @@ class BatchScheduler(Scheduler):
         # collect-at-idle gc policy, engaged only by the production run
         # loop (tests driving schedule_batch directly keep gc untouched)
         self._gc_guard = None
+        # solver degradation ladder (robustness/): per-tier circuit
+        # breakers + retry + watchdog around every device interaction,
+        # so a sick device path steps down Pallas -> XLA -> host greedy
+        # -> sequential oracle and the batch ALWAYS completes
+        self.ladder = SolverLadder(robustness_config)
+        # bind retries share the ladder's policy + injectable sleep
+        self.bind_retry_policy = self.ladder.config.retry
+        self._retry_sleep = self.ladder.config.sleep
+        # set when the committer failed to join at shutdown (satellite:
+        # the silent join(timeout=10) hang) -- surfaced via the
+        # scheduler_degraded_health gauge and this flag
+        self.commit_degraded = False
 
     # -- one batch ----------------------------------------------------------
 
@@ -346,14 +371,21 @@ class BatchScheduler(Scheduler):
         pending = self._dispatch_solve(solver_infos, pod_scheduling_cycle)
         if pending is None:
             return
-        if any(
-            pi.pod.metadata.labels.get(POD_GROUP_LABEL)
-            for pi in solver_infos
-        ):
-            pending = self._gang_fixup(solver_infos, pending)
-            if pending is None:
-                return
-        self._complete_solve(pending)
+        try:
+            if any(
+                pi.pod.metadata.labels.get(POD_GROUP_LABEL)
+                for pi in solver_infos
+            ):
+                pending = self._gang_fixup(solver_infos, pending)
+                if pending is None:
+                    return
+            self._complete_solve(pending)
+        except Exception:
+            # a failed download/commit must not crash the dispatch loop:
+            # requeue the batch's pods (they retry on whatever tier the
+            # breakers now route to) and drop the stale carry
+            logger.exception("synchronous batch completion failed")
+            self._recover_failed_batch(pending)
 
     # -- gang all-or-nothing group masks (SURVEY stage 6) --------------------
 
@@ -444,6 +476,21 @@ class BatchScheduler(Scheduler):
         with self._pending_cv:
             return bool(self._pending_q)
 
+    def _device_tiers(
+        self, mode: str, b: int, n_cap: int, r_dims: int, u_rows: int
+    ) -> List[str]:
+        """Device tiers live for this (mode, shape), ladder order. The
+        pallas tier is only offered when solve_packed would actually run
+        the fused kernel (shared predicate ops.assignment
+        .pallas_candidate) -- otherwise a shape-ineligible batch would
+        run the identical XLA solve twice on failure and charge it to
+        the pallas breaker. The XLA scan is always available."""
+        from kubernetes_tpu.ops.assignment import pallas_candidate
+
+        if pallas_candidate(mode, b, n_cap, r_dims, u_rows):
+            return [TIER_PALLAS, TIER_XLA]
+        return [TIER_XLA]
+
     def _pending_has_required_anti(self) -> bool:
         with self._pending_cv:
             return any(p.get("has_required_anti") for p in self._pending_q)
@@ -487,6 +534,22 @@ class BatchScheduler(Scheduler):
             self._pending_cv.notify_all()
         if self._committer is not None:
             self._committer.join(timeout=10)
+            if self._committer.is_alive():
+                # the join timed out: the committer is wedged (most
+                # likely a hung result download over the serving link).
+                # Silence here would strand in-flight batches invisibly
+                # -- log, count, and raise the degraded-health flag so
+                # operators and the health endpoint see it.
+                logger.error(
+                    "committer thread failed to join within 10s; "
+                    "%d batch(es) may be stranded in flight",
+                    len(self._pending_q),
+                )
+                metrics.commit_join_timeouts.inc()
+                metrics.degraded_health.set(
+                    1, reason="committer_join_timeout"
+                )
+                self.commit_degraded = True
             self._committer = None
 
     def _committer_loop(self) -> None:
@@ -570,7 +633,12 @@ class BatchScheduler(Scheduler):
         cache then reflects every dispatched placement)."""
         if self._committer is None:
             while self._pending_q:
-                self._complete_solve(self._pending_q.popleft())
+                pend = self._pending_q.popleft()
+                try:
+                    self._complete_solve(pend)
+                except Exception:
+                    logger.exception("drain commit failed")
+                    self._recover_failed_batch(pend)
             return
         with self._pending_cv:
             while self._pending_q:
@@ -1016,32 +1084,103 @@ class BatchScheduler(Scheduler):
             # pass None for pieces riding the buffer so the jit sees one
             # stable signature per layout (a stale device ref would fork
             # a needless compile variant)
-            with timeline.span("solve_dispatch"):
-                (
-                    assignments_dev, req_out, nzr_out, alloc_out, valid_out,
-                ) = solve_packed(
+            solve_mode = "constrained" if constrained else self.solver_mode
+
+            def run_device(allow_pallas: bool):
+                inj = get_injector()
+                if inj is not None:
+                    hang = inj.hang_seconds_maybe(
+                        FaultPoint.DEVICE_SOLVE_HANG
+                    )
+                    if hang > 0:
+                        time.sleep(hang)
+                    inj.raise_maybe(FaultPoint.DEVICE_SOLVE)
+                return solve_packed(
                     pieces,
                     ds.alloc_dev if static_ok else None,
                     ds.valid_dev if static_ok else None,
                     ds.req_dev if carry_ok else None,
                     ds.nzr_dev if carry_ok else None,
                     config=self.solver_config,
-                    mode="constrained" if constrained
-                    else self.solver_mode,
+                    mode=solve_mode,
+                    allow_pallas=allow_pallas,
                 )
-            if not static_ok:
-                ds.alloc_dev, ds.valid_dev = alloc_out, valid_out
-                ds.alloc_shadow = nt.allocatable.copy()
-                ds.valid_shadow = nt.valid.copy()
+
+            def run_host_greedy():
+                a, r_out, z_out = host_greedy_assign(
+                    nt.allocatable, node_requested, node_nzr, nt.valid,
+                    req, nzr, rows, midx, active,
+                    config=self.solver_config,
+                )
+                return a, r_out, z_out, None, None
+
+            attempts = [
+                (t, (lambda ap=(t == TIER_PALLAS): run_device(ap)))
+                for t in self._device_tiers(
+                    solve_mode, padded, nt.capacity, nt.dims.num_dims,
+                    u_padded,
+                )
+            ]
+            # the host tier needs host state that reflects EVERY
+            # placement; with batches in flight the device carry is
+            # ahead of node_requested, so the tier is only offered when
+            # nothing is pending (exhaustion with pending batches drains
+            # and redispatches from fresh host state instead)
+            if not constrained and not self._pending_exists():
+                attempts.append((TIER_HOST_GREEDY, run_host_greedy))
             try:
-                assignments_dev.copy_to_host_async()
-            except AttributeError:
-                pass
-            if overlaid:
-                ds.invalidate_carry()
+                with timeline.span("solve_dispatch"):
+                    tier, out = self.ladder.run(
+                        attempts, label=f"batch b={b}"
+                    )
+            except LadderExhausted:
+                with self._shadow_lock:
+                    ds.invalidate_carry()
+                if self._pending_exists():
+                    # in-flight batches blocked the host tier: land them
+                    # (the committer's own recovery handles their
+                    # failures), then redo this dispatch from fresh host
+                    # state with the breakers now routing around the
+                    # sick tiers
+                    self._drain_pending()
+                    return self._dispatch_solve(
+                        solver_infos, pod_scheduling_cycle,
+                        inactive_uids=inactive_uids,
+                    )
+                metrics.solver_fallbacks.inc(
+                    tier=TIER_SEQUENTIAL, reason="ladder_exhausted"
+                )
+                self.ladder.record_sequential(len(solver_infos))
+                logger.warning(
+                    "solver ladder exhausted; %d pods take the "
+                    "sequential oracle path", len(solver_infos),
+                )
+                for pi in solver_infos:
+                    self.pods_fallback += 1
+                    self.attempt_schedule(pi)
+                return None
+            assignments_dev, req_out, nzr_out, alloc_out, valid_out = out
+            if tier == TIER_HOST_GREEDY:
+                # the host tier solved from host state: the device carry
+                # (and any pre-solve shadow bookkeeping above) no longer
+                # describes device-resident reality
+                with self._shadow_lock:
+                    ds.invalidate_carry()
             else:
-                ds.req_dev, ds.nzr_dev = req_out, nzr_out
+                if not static_ok:
+                    ds.alloc_dev, ds.valid_dev = alloc_out, valid_out
+                    ds.alloc_shadow = nt.allocatable.copy()
+                    ds.valid_shadow = nt.valid.copy()
+                try:
+                    assignments_dev.copy_to_host_async()
+                except AttributeError:
+                    pass
+                if overlaid:
+                    ds.invalidate_carry()
+                else:
+                    ds.req_dev, ds.nzr_dev = req_out, nzr_out
             return {
+                "tier": tier,
                 "solver_infos": list(solver_infos),
                 "has_required_anti": has_required_anti,
                 "has_ports": batch_ports,
@@ -1105,44 +1244,28 @@ class BatchScheduler(Scheduler):
             ds.alloc_dev, req_state_d, nzr_state_d, ds.valid_dev,
             req_d, nzr_d, rows_d, midx_d, active_d,
         )
-        if spread is None and affinity is None and score_batch is None:
-            solver = (
-                sinkhorn_assign
-                if self.solver_mode == "sinkhorn"
-                else greedy_assign_compact
+        try:
+            inj = get_injector()
+            if inj is not None:
+                inj.raise_maybe(FaultPoint.DEVICE_SOLVE)
+            assignments_dev, req_out, nzr_out = self._mesh_solve(
+                common_args, spread, affinity, score_batch, padded, nt
             )
-            assignments_dev, req_out, nzr_out = solver(
-                *common_args, config=self.solver_config
+        except Exception:
+            # mesh path: no pallas/host tier distinction -- a failed
+            # sharded solve steps straight down to the sequential oracle
+            logger.exception("mesh solve failed; sequential fallback")
+            metrics.solver_fallbacks.inc(
+                tier=TIER_SEQUENTIAL, reason="mesh_solve_error"
             )
-        else:
-            # the packers saw the pods already in solve order
-            if spread is not None:
-                sp_tensors = pad_spread_tensors(spread, padded)
-            else:
-                sp_tensors = noop_spread_tensors(padded, nt.capacity)
-            if affinity is not None:
-                af_tensors = pad_affinity_tensors(affinity, padded)
-            else:
-                af_tensors = noop_affinity_tensors(padded, nt.capacity)
-            if score_batch is not None:
-                sc_tensors = pad_score_tensors(score_batch, padded)
-            else:
-                sc_tensors = noop_score_tensors(padded, nt.capacity)
-            # common_args carries (mask_rows, mask_index) in compact form;
-            # the constrained kernel takes the same layout
-            if self.mesh is not None:
-                # constraint tensors are small: replicate on the mesh
-                sp_dev, af_dev, sc_dev = jax.device_put(
-                    (sp_tensors, af_tensors, sc_tensors), self._sh_repl
-                )
-            else:
-                sp_dev, af_dev, sc_dev = jax.device_put(
-                    (sp_tensors, af_tensors, sc_tensors)
-                )
-            assignments_dev, req_out, nzr_out = greedy_assign_constrained(
-                *common_args, tuple(sp_dev), tuple(af_dev), tuple(sc_dev),
-                config=self.solver_config,
-            )
+            with self._shadow_lock:
+                ds.invalidate_carry()
+            self._drain_pending()
+            self.ladder.record_sequential(len(solver_infos))
+            for pi in solver_infos:
+                self.pods_fallback += 1
+                self.attempt_schedule(pi)
+            return None
         # start the result transfer now so it overlaps host commit work
         try:
             assignments_dev.copy_to_host_async()
@@ -1156,6 +1279,7 @@ class BatchScheduler(Scheduler):
             ds.req_dev, ds.nzr_dev = req_out, nzr_out
 
         return {
+            "tier": TIER_XLA,  # mesh solves are plain XLA lowerings
             # copy: the caller's list is cleared after dispatch returns
             "solver_infos": list(solver_infos),
             "has_required_anti": has_required_anti,
@@ -1176,12 +1300,106 @@ class BatchScheduler(Scheduler):
             "mask_index_solved": midx,
         }
 
+    def _mesh_solve(
+        self, common_args, spread, affinity, score_batch, padded, nt
+    ):
+        """One sharded solve on the mesh (unconstrained or constrained);
+        factored out of _dispatch_solve so the caller can guard it."""
+        if spread is None and affinity is None and score_batch is None:
+            solver = (
+                sinkhorn_assign
+                if self.solver_mode == "sinkhorn"
+                else greedy_assign_compact
+            )
+            return solver(*common_args, config=self.solver_config)
+        # the packers saw the pods already in solve order
+        if spread is not None:
+            sp_tensors = pad_spread_tensors(spread, padded)
+        else:
+            sp_tensors = noop_spread_tensors(padded, nt.capacity)
+        if affinity is not None:
+            af_tensors = pad_affinity_tensors(affinity, padded)
+        else:
+            af_tensors = noop_affinity_tensors(padded, nt.capacity)
+        if score_batch is not None:
+            sc_tensors = pad_score_tensors(score_batch, padded)
+        else:
+            sc_tensors = noop_score_tensors(padded, nt.capacity)
+        # common_args carries (mask_rows, mask_index) in compact form;
+        # the constrained kernel takes the same layout
+        if self.mesh is not None:
+            # constraint tensors are small: replicate on the mesh
+            sp_dev, af_dev, sc_dev = jax.device_put(
+                (sp_tensors, af_tensors, sc_tensors), self._sh_repl
+            )
+        else:
+            sp_dev, af_dev, sc_dev = jax.device_put(
+                (sp_tensors, af_tensors, sc_tensors)
+            )
+        return greedy_assign_constrained(
+            *common_args, tuple(sp_dev), tuple(af_dev), tuple(sc_dev),
+            config=self.solver_config,
+        )
+
     def _complete_solve(self, p) -> None:
         """Download the assignments, mirror the scan's node-state deltas
         into the host shadow (same int32 arithmetic), then run the batched
-        commit pipeline."""
-        with timeline.span("download"):
-            assignments = np.asarray(p["assignments_dev"])
+        commit pipeline.
+
+        The download is the other blocking device interaction (a wedged
+        serving link hangs np.asarray forever), so it runs under the same
+        wall-clock watchdog as the solve, and the result is validated
+        before it drives commits: garbage indices from a sick device
+        (NaN-score argmax artifacts) must degrade, not bind pods to
+        phantom nodes. Failures raise; the callers route the batch
+        through _recover_failed_batch (requeue, never strand)."""
+        tier = p.get("tier", TIER_XLA)
+        breaker = self.ladder.breakers.get(tier)
+        timeout = (
+            self.ladder.config.solve_timeout_seconds
+            if tier in (TIER_PALLAS, TIER_XLA)
+            and self.ladder.config.enabled
+            else 0.0
+        )
+
+        def download():
+            return np.asarray(p["assignments_dev"])
+
+        try:
+            with timeline.span("download"):
+                assignments = self.ladder.watchdog.call(
+                    download, timeout, tier=tier
+                )
+        except SolveTimeout:
+            if breaker is not None:
+                breaker.force_open()
+            metrics.solver_fallbacks.inc(
+                tier=TIER_SEQUENTIAL, reason=f"{tier}_download_timeout"
+            )
+            raise
+        except Exception:
+            if breaker is not None:
+                breaker.record_failure()
+            raise
+        inj = get_injector()
+        if inj is not None:
+            assignments = inj.corrupt_assignments_maybe(
+                FaultPoint.SOLVE_GARBAGE, assignments
+            )
+        head = assignments[: p["b"]]
+        if head.size and (
+            (head < NO_NODE).any() or (head >= len(p["names"])).any()
+        ):
+            # out-of-range node indices: the solve result is garbage
+            if breaker is not None:
+                breaker.record_failure()
+            metrics.solver_fallbacks.inc(
+                tier=TIER_SEQUENTIAL, reason=f"{tier}_garbage_result"
+            )
+            raise RuntimeError(
+                f"solve on tier {tier!r} returned out-of-range "
+                f"assignments; discarding the batch result"
+            )
         p["solve_timer"].observe()
         b = p["b"]
         metrics.batch_size.observe(b)
@@ -1557,6 +1775,36 @@ class BatchScheduler(Scheduler):
                 # finds it (and its device upload) already warm
                 self._prewarm_next_commit = True
 
+    def _bind_bulk_with_retry(self, assumed_list):
+        """bind_assumed_bulk with retry-with-backoff around TRANSACTION
+        failures (apiserver unavailable, injected conflict burst).
+        Per-slot errors are the API's answer, not a transport failure --
+        they return to the caller, whose per-slot handling already does
+        forget + Unreserve + requeue. On terminal transaction failure
+        every slot becomes an error so no pod is silently stranded
+        assumed."""
+        policy = self.ladder.config.retry
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                inj = get_injector()
+                if inj is not None:
+                    inj.raise_maybe(FaultPoint.BIND_CONFLICT)
+                return self.client.bind_assumed_bulk(assumed_list)
+            except Exception as e:  # noqa: BLE001 - transaction failure
+                # max_attempts counts TOTAL attempts (ladder semantics)
+                if attempt >= max(1, policy.max_attempts):
+                    logger.exception(
+                        "bulk bind failed terminally after %d attempts",
+                        attempt,
+                    )
+                    return [(i, e) for i in range(len(assumed_list))]
+                metrics.bind_retries.inc()
+                self.ladder.config.sleep(
+                    policy.backoff_for_attempt(attempt)
+                )
+
     def _bulk_binding_cycle_safe(
         self, items, pod_scheduling_cycle, snapshot=None
     ) -> None:
@@ -1622,7 +1870,7 @@ class BatchScheduler(Scheduler):
         assumed_list = [t[3] for t in ready]
         bind_timer = metrics.SinceTimer(metrics.binding_duration)
         with timeline.span("bind_bulk"):
-            errors = self.client.bind_assumed_bulk(assumed_list)
+            errors = self._bind_bulk_with_retry(assumed_list)
         bind_timer.observe()
         if errors:
             failed = dict(errors)

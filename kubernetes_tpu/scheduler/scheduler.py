@@ -32,6 +32,8 @@ from kubernetes_tpu.framework.registry import Registry
 from kubernetes_tpu.framework.runtime import Framework
 from kubernetes_tpu.plugins import new_in_tree_registry
 from kubernetes_tpu.queue.scheduling_queue import PriorityQueue
+from kubernetes_tpu.robustness.circuit import RetryPolicy
+from kubernetes_tpu.robustness.faults import FaultPoint, get_injector
 from kubernetes_tpu.scheduler.generic import GenericScheduler
 from kubernetes_tpu.scheduler.provider import default_plugins
 from kubernetes_tpu.utils import metrics
@@ -66,6 +68,11 @@ class Scheduler:
         self._stop = threading.Event()
         self._inflight_binds = 0
         self._inflight_lock = threading.Condition()
+        # bind/commit retry policy (robustness/): transient API failures
+        # retry with backoff before the terminal failure path (which
+        # guarantees forget + Unreserve + requeue)
+        self.bind_retry_policy = RetryPolicy()
+        self._retry_sleep = time.sleep
 
     # -- profile lookup (scheduler.go:741 profileForPod) --------------------
 
@@ -79,10 +86,17 @@ class Scheduler:
         return prof
 
     def _skip_pod_schedule(self, pod: Pod) -> bool:
-        """scheduler.go:750 skipPodSchedule: deleting or already assumed."""
+        """scheduler.go:750 skipPodSchedule: deleting or already assumed.
+        Also skips pods already CONFIRMED in the cache: a stale watch
+        event (e.g. a pre-bind annotation write) can re-queue a pod that
+        bound moments ago, and re-attempting it double-places it or --
+        worse -- runs its failure/Unreserve path against the live
+        placement's durable state."""
         if pod.metadata.deletion_timestamp is not None:
             return True
         if self.cache.is_assumed_pod(pod):
+            return True
+        if self.cache.has_pod_uid(pod.metadata.uid):
             return True
         return False
 
@@ -158,11 +172,39 @@ class Scheduler:
                     return None
                 except Exception as e:
                     return Status.error(str(e))
-        status = prof.run_bind_plugins(state, assumed, host)
+        status = self._bind_with_retry(prof, state, assumed, host)
         self.cache.finish_binding(assumed)
         if status is not None and status.code == StatusCode.SKIP:
             return Status.error("no bind plugin handled the pod")
         return status
+
+    def _bind_with_retry(
+        self, prof: Framework, state: CycleState, assumed: Pod, host: str
+    ) -> Optional[Status]:
+        """The bind plugins with retry-with-exponential-backoff around
+        transient failures (API conflict/unavailable, injected
+        bind_conflict). A terminal failure returns the error status; the
+        binding cycle's existing failure path then guarantees forget +
+        Unreserve + requeue -- a bind failure never strands a pod
+        assumed-forever."""
+        policy = self.bind_retry_policy
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                inj = get_injector()
+                if inj is not None:
+                    inj.raise_maybe(FaultPoint.BIND_CONFLICT)
+                return prof.run_bind_plugins(state, assumed, host)
+            except Exception as e:  # noqa: BLE001 - bind transport error
+                # max_attempts counts TOTAL attempts (same semantics as
+                # the solve ladder's in-place retries)
+                if attempt >= max(1, policy.max_attempts):
+                    return Status.error(
+                        f"bind failed after {attempt} attempts: {e}"
+                    )
+                metrics.bind_retries.inc()
+                self._retry_sleep(policy.backoff_for_attempt(attempt))
 
     # -- the loop -----------------------------------------------------------
 
@@ -474,6 +516,7 @@ def new_scheduler(
     solver_mode: str = "greedy",
     mesh=None,
     extenders: Optional[List] = None,
+    robustness_config=None,
 ) -> Scheduler:
     """Build a fully wired scheduler (reference scheduler.go:223 New +
     factory.go create). ``batch=True`` selects the TPU batch-solver loop
@@ -556,6 +599,7 @@ def new_scheduler(
             solver_config=solver_config or GreedyConfig(),
             solver_mode=solver_mode,
             mesh=mesh,
+            robustness_config=robustness_config,
         )
     else:
         sched = Scheduler(
@@ -617,6 +661,12 @@ def new_scheduler_from_config(
         mesh = Mesh(
             np.array(devices[: ts.mesh_devices]), axis_names=("nodes",)
         )
+    from kubernetes_tpu.robustness.faults import (
+        injector_from_configuration,
+        install_injector,
+    )
+    from kubernetes_tpu.robustness.ladder import RobustnessConfig
+
     sched = new_scheduler(
         client,
         informer_factory,
@@ -629,9 +679,15 @@ def new_scheduler_from_config(
         solver_mode=ts.solver_mode,
         mesh=mesh,
         extenders=list(getattr(cfg, "extenders", [])),
+        robustness_config=RobustnessConfig.from_configuration(
+            cfg.robustness
+        ),
     )
     if ts.enabled:
         sched.batch_window = ts.batch_window_seconds
+    injector = injector_from_configuration(cfg.fault_injection)
+    if injector is not None:
+        install_injector(injector)
     return sched
 
 

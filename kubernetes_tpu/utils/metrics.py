@@ -146,13 +146,18 @@ class Histogram:
                 cumulative = 0
                 for i, b in enumerate(self.buckets):
                     cumulative += self._counts[key][i]
+                    # the le label is hoisted into a variable: a backslash
+                    # inside an f-string expression is 3.12-only syntax,
+                    # and this module must import on 3.10
+                    le_label = 'le="%s"' % b
                     out.append(
                         f"{self.name}_bucket"
-                        f"{_fmt_labels(key, f'le=\"{b}\"')} "
+                        f"{_fmt_labels(key, le_label)} "
                         f"{cumulative}"
                     )
+                le_inf = 'le="+Inf"'
                 out.append(
-                    f"{self.name}_bucket{_fmt_labels(key, 'le=\"+Inf\"')} "
+                    f"{self.name}_bucket{_fmt_labels(key, le_inf)} "
                     f"{self._totals[key]}"
                 )
                 out.append(f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]}")
@@ -257,6 +262,48 @@ batch_size = registry.register(Histogram(
     "scheduler_tpu_batch_size",
     "Pods per device-solved batch.",
     buckets=(1, 8, 32, 64, 128, 256, 512, 1024),
+))
+# robustness subsystem (kubernetes_tpu/robustness/): fault injection,
+# solver degradation ladder, circuit breakers -- degradation must be
+# observable, not silent
+faults_injected = registry.register(Counter(
+    "scheduler_faults_injected_total",
+    "Faults fired by the injection harness, by injection point.",
+    ("point",),
+))
+breaker_transitions = registry.register(Counter(
+    "scheduler_circuit_breaker_transitions_total",
+    "Circuit breaker state transitions, by solver tier and edge.",
+    ("tier", "from_state", "to_state"),
+))
+solver_fallbacks = registry.register(Counter(
+    "scheduler_solver_fallback_total",
+    "Batches stepped down the solver degradation ladder, by the tier "
+    "that handled them and the reason the higher tier was skipped.",
+    ("tier", "reason"),
+))
+solve_retries = registry.register(Counter(
+    "scheduler_solve_retries_total",
+    "Device-solve retries before stepping down the ladder, by tier.",
+    ("tier",),
+))
+bind_retries = registry.register(Counter(
+    "scheduler_bind_retries_total",
+    "Bind/commit attempts retried after a transient API failure.",
+))
+watch_relists = registry.register(Counter(
+    "scheduler_watch_relist_total",
+    "Informer relists forced by a broken watch stream, by kind.",
+    ("kind",),
+))
+commit_join_timeouts = registry.register(Counter(
+    "scheduler_commit_thread_join_timeouts_total",
+    "Committer threads that failed to join at shutdown.",
+))
+degraded_health = registry.register(Gauge(
+    "scheduler_degraded_health",
+    "1 when a component is operating degraded, by reason.",
+    ("reason",),
 ))
 
 

@@ -9,6 +9,23 @@ JAX_PLATFORMS, so the config is also pinned programmatically.
 """
 
 import os
+import sys
+
+# -- tier-0 syntax gate --------------------------------------------------
+# ast-parse the whole tree before pytest collects anything: an
+# uncollectable module then fails the run fast with its file name
+# instead of 21 opaque collection errors (tools/check_syntax.py).
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO_ROOT, "tools"))
+import check_syntax  # noqa: E402
+
+_syntax_failures = check_syntax.check_tree(base_dir=_REPO_ROOT)
+if _syntax_failures:
+    _lines = "\n".join(f"  {p}: {e}" for p, e in _syntax_failures)
+    raise SystemExit(
+        f"tier-0 syntax gate failed ({len(_syntax_failures)} file(s) do "
+        f"not parse on Python {sys.version.split()[0]}):\n{_lines}"
+    )
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 prev = os.environ.get("XLA_FLAGS", "")
