@@ -86,6 +86,154 @@ class BindWatcher:
         self._thread.join(timeout=2)
 
 
+def run_ha_chaos_bench(fault_seed: int) -> None:
+    """The HA failover bench (--fault-profile ha-chaos): TWO full
+    scheduler stacks (own informers/cache/queue/solver) leader-elected
+    over one shared apiserver, under the seeded ha-chaos profile (renew
+    failures, transient API unavailability, truncated watch windows, a
+    bind-conflict burst). One third of the way into the burst the leader
+    is killed -- its renews fail permanently via a TARGETED
+    lease_renew_fail injector -- and the standby seizes the lease and
+    drains the backlog. The JSON line reports the failover takeover
+    latency (kill -> standby holds the lease) alongside throughput and
+    the fencing-abort count, so HA regressions are benchmarkable the
+    same way solver regressions are."""
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.client.client import Client
+    from kubernetes_tpu.client.informer import InformerFactory
+    from kubernetes_tpu.config.types import LeaderElectionConfiguration
+    from kubernetes_tpu.robustness.faults import (
+        FaultInjector,
+        FaultPoint,
+        FaultProfile,
+        PointConfig,
+        install_injector,
+        load_profile,
+    )
+    from kubernetes_tpu.scheduler.leaderelection import LeaderElector
+    from kubernetes_tpu.scheduler.scheduler import new_scheduler
+    from kubernetes_tpu.testing import make_node, make_pod
+    from kubernetes_tpu.utils import metrics
+
+    num_nodes = int(os.environ.get("BENCH_NODES", 2000))
+    num_pods = int(os.environ.get("BENCH_PODS", 4000))
+    max_batch = int(os.environ.get("BENCH_BATCH", 1024))
+
+    server = APIServer()
+    client = Client(server)
+    for i in range(num_nodes):
+        client.create_node(
+            make_node(f"node-{i}")
+            .capacity(cpu="32", memory="64Gi", pods=110)
+            .obj()
+        )
+
+    le_cfg = LeaderElectionConfiguration(
+        leader_elect=True,
+        lease_duration_seconds=1.0,
+        renew_deadline_seconds=2.0,
+        retry_period_seconds=0.1,
+    )
+
+    stacks = []
+    for identity in ("ha-a", "ha-b"):
+        informers = InformerFactory(server)
+        sched = new_scheduler(
+            client, informers, batch=True, max_batch=max_batch
+        )
+        elector = LeaderElector(
+            client, le_cfg, identity,
+            on_started_leading=sched.run,
+            on_stopped_leading=sched.stop,
+        )
+        # electors are ISOLATED from the global chaos stream (empty
+        # targeted injector): abdication here is single-shot (process
+        # restart semantics), so only the deliberate kill below may
+        # depose -- the global profile still drives api_unavailable /
+        # watch truncation / bind conflicts through everything else
+        elector.fault_injector = FaultInjector(
+            FaultProfile("none", seed=0)
+        )
+        sched.fencing_check = elector.holds_lease
+        informers.start()
+        informers.wait_for_cache_sync()
+        stacks.append((identity, informers, sched, elector))
+
+    # compile off the clock (jit caches are process-global: one warmup
+    # covers both stacks)
+    stacks[0][2].warmup()
+
+    # leader first, then the standby contends
+    threads = []
+    for _, _, _, elector in stacks:
+        t = threading.Thread(target=elector.run, daemon=True)
+        t.start()
+        threads.append(t)
+        deadline = time.time() + 10
+        while not stacks[0][3].is_leader and time.time() < deadline:
+            time.sleep(0.02)
+
+    burst = [
+        make_pod(f"burst-{i}").container(cpu="250m", memory="512Mi").obj()
+        for i in range(num_pods)
+    ]
+    burst_names = {p.metadata.name for p in burst}
+    watcher = BindWatcher(server, burst_names)
+    # global seeded chaos from here (after the bench's own watch opened:
+    # the harness must not eat its own injected 410)
+    install_injector(FaultInjector(load_profile("ha-chaos", seed=fault_seed)))
+    start = time.perf_counter()
+    for i in range(0, num_pods, 256):
+        client.create_pods_bulk(burst[i:i + 256])
+
+    # kill the leader one third of the way in: targeted renew failure
+    deadline = time.time() + 300
+    while len(watcher.bind_times) < num_pods // 3 and time.time() < deadline:
+        time.sleep(0.02)
+    t_kill = time.perf_counter()
+    stacks[0][3].fault_injector = FaultInjector(FaultProfile(
+        "leader-kill", seed=fault_seed,
+        points={FaultPoint.LEASE_RENEW_FAIL: PointConfig(rate=1.0)},
+    ))
+    deadline = time.time() + 60
+    while not stacks[1][3].is_leader and time.time() < deadline:
+        time.sleep(0.005)
+    took_over = stacks[1][3].is_leader
+    takeover_s = time.perf_counter() - t_kill
+    completed = watcher.wait_for_targets(time.time() + 300)
+    elapsed = time.perf_counter() - start
+    for _, informers, sched, elector in stacks:
+        sched.wait_for_inflight_binds(timeout=30)
+    watcher.stop()
+
+    pods, _ = client.list_pods()
+    bound = sum(
+        1 for p in pods
+        if p.spec.node_name and p.metadata.name in burst_names
+    )
+    for _, informers, sched, elector in stacks:
+        elector.stop()
+        sched.stop()
+        informers.stop()
+    install_injector(None)
+
+    record = {
+        "metric": "ha_chaos_failover_takeover",
+        "value": round(takeover_s * 1000, 1),
+        "unit": "ms",
+        "fault_profile": "ha-chaos",
+        "failover_takeover_ms": round(takeover_s * 1000, 1),
+        "pods_per_sec_under_failover": round(num_pods / elapsed, 1),
+        "pods_bound": bound,
+        "pods_total": num_pods,
+        "fencing_aborts": metrics.fencing_aborts.value(),
+        "standby_took_over": took_over,
+    }
+    if not completed or bound < num_pods:
+        record["error"] = f"only {bound}/{num_pods} pods scheduled"
+    print(json.dumps(record))
+
+
 def main() -> None:
     import argparse
 
@@ -93,9 +241,10 @@ def main() -> None:
     ap.add_argument(
         "--fault-profile", default=os.environ.get("BENCH_FAULT_PROFILE", ""),
         help="named fault-injection profile (robustness/faults.py: "
-        "chaos-default, device-down, garbage-scores, flaky-watch) -- "
-        "deterministic chaos alongside throughput, so robustness "
-        "regressions are benchmarkable",
+        "chaos-default, device-down, garbage-scores, flaky-watch, "
+        "ha-chaos) -- deterministic chaos alongside throughput, so "
+        "robustness regressions are benchmarkable; ha-chaos runs the "
+        "two-stack HA failover harness and reports takeover latency",
     )
     ap.add_argument(
         "--fault-seed", type=int,
@@ -103,6 +252,11 @@ def main() -> None:
         help="seed for the injection profile's RNG streams",
     )
     args = ap.parse_args()
+
+    if args.fault_profile == "ha-chaos":
+        # the HA failover bench has its own two-stack harness
+        run_ha_chaos_bench(args.fault_seed)
+        return
 
     num_nodes = int(os.environ.get("BENCH_NODES", 5000))
     num_pods = int(os.environ.get("BENCH_PODS", 10000))

@@ -25,6 +25,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api.types import Binding, Node, Pod
+from kubernetes_tpu.robustness.faults import FaultPoint, get_injector
 
 try:
     from kubernetes_tpu.native import cow_clone as _cow_clone
@@ -59,6 +60,23 @@ class NotFound(KeyError):
 
 class Conflict(ValueError):
     pass
+
+
+class Gone(Exception):
+    """410 Gone analogue (apiserver storage.NewTooLargeResourceVersionError
+    inverse): the requested since_rv predates the oldest retained watch
+    event, so replay would silently miss events. The watcher must relist
+    and diff instead. Deliberately NOT a KeyError/ValueError subclass --
+    callers that treat those as not-found/conflict must not swallow it."""
+
+
+def _api_unavailable_maybe() -> None:
+    """Injected whole-transaction failure (the api_unavailable point):
+    list/bind/guaranteed_update raise as if the server were unreachable;
+    retry policies and informer relists are expected to absorb it."""
+    inj = get_injector()
+    if inj is not None:
+        inj.raise_maybe(FaultPoint.API_UNAVAILABLE)
 
 
 @dataclass(slots=True)
@@ -157,12 +175,16 @@ class APIServer:
         # bounded per-kind event history for watch(since_rv) replay
         self._history: Dict[str, List[WatchEvent]] = {k: [] for k in self.KINDS}
         self._history_limit = watch_history_limit
+        # highest rv ever trimmed out of a kind's history: a watch asking
+        # to replay from below this would silently miss events -> Gone
+        self._history_trunc_rv: Dict[str, int] = {k: 0 for k in self.KINDS}
 
     def _ensure_kind(self, kind: str) -> None:
         if kind not in self._stores:
             self._stores[kind] = {}
             self._watches[kind] = []
             self._history[kind] = []
+            self._history_trunc_rv[kind] = 0
 
     # -- core ---------------------------------------------------------------
 
@@ -170,11 +192,18 @@ class APIServer:
         self._rv += 1
         return self._rv
 
+    def _trim_history(self, kind: str, hist: List[WatchEvent]) -> None:
+        if len(hist) > self._history_limit:
+            cut = len(hist) // 2
+            # record the highest discarded rv so watch(since_rv) can
+            # detect a replay gap instead of silently skipping it
+            self._history_trunc_rv[kind] = hist[cut - 1].resource_version
+            del hist[:cut]
+
     def _broadcast(self, kind: str, event: WatchEvent) -> None:
         hist = self._history[kind]
         hist.append(event)
-        if len(hist) > self._history_limit:
-            del hist[: len(hist) // 2]
+        self._trim_history(kind, hist)
         for w in list(self._watches[kind]):
             w._deliver(event)
 
@@ -185,8 +214,7 @@ class APIServer:
             return
         hist = self._history[kind]
         hist.extend(events)
-        if len(hist) > self._history_limit:
-            del hist[: len(hist) // 2]
+        self._trim_history(kind, hist)
         for w in list(self._watches[kind]):
             w._deliver_many(events)
 
@@ -247,6 +275,7 @@ class APIServer:
 
     def list(self, kind: str) -> Tuple[List[Any], int]:
         """Returns (objects, resourceVersion) -- the list+watch handshake."""
+        _api_unavailable_maybe()
         with self._lock:
             self._ensure_kind(kind)
             return list(self._stores[kind].values()), self._rv
@@ -288,6 +317,7 @@ class APIServer:
         """
         import copy as _copy
 
+        _api_unavailable_maybe()
         with self._lock:
             old = self.get(kind, namespace, name)
             cow_attrs = tuple(
@@ -341,6 +371,21 @@ class APIServer:
     def watch(self, kind: str, since_rv: int = 0) -> Watch:
         with self._lock:
             self._ensure_kind(kind)
+            inj = get_injector()
+            if inj is not None and inj.should_fire(
+                FaultPoint.WATCH_HISTORY_TRUNCATED
+            ):
+                raise Gone(
+                    f"{kind} watch history truncated (injected 410)"
+                )
+            if since_rv < self._history_trunc_rv.get(kind, 0):
+                # events in (since_rv, trunc_rv] were trimmed: replaying
+                # only what's retained would silently skip them
+                raise Gone(
+                    f"{kind} watch history truncated past rv "
+                    f"{self._history_trunc_rv[kind]}; cannot replay from "
+                    f"{since_rv}"
+                )
             w = Watch(self, kind)
             for ev in self._history[kind]:
                 if ev.resource_version > since_rv:
@@ -357,10 +402,14 @@ class APIServer:
 
     # -- pods/binding subresource (storage.go:159 BindingREST.Create) -------
 
-    def _bind_locked(self, binding: Binding) -> Pod:
+    def _bind_locked(self, binding: Binding) -> Tuple[Pod, bool]:
         """Validate + apply one binding; caller holds the store lock.
-        Returns the updated pod and appends nothing -- the caller decides
-        how to fan out the watch event (single vs bulk delivery)."""
+        Returns (pod, changed) and appends nothing -- the caller decides
+        how to fan out the watch event (single vs bulk delivery).
+        ``changed`` is False when the pod was ALREADY bound to the same
+        node: a retried commit whose first attempt actually landed (or a
+        restarted scheduler re-driving a recovered placement) is
+        idempotent success, not a conflict -- no write, no event."""
         store = self._stores["Pod"]
         old: Optional[Pod] = store.get(
             (binding.pod_namespace, binding.pod_name)
@@ -374,7 +423,9 @@ class APIServer:
                 f"pod {old.key()} uid mismatch: binding has "
                 f"{binding.pod_uid}, pod has {old.metadata.uid}"
             )
-        if old.spec.node_name and old.spec.node_name != binding.target_node:
+        if old.spec.node_name:
+            if old.spec.node_name == binding.target_node:
+                return old, False
             raise Conflict(
                 f"pod {old.key()} is already bound to {old.spec.node_name}"
             )
@@ -396,14 +447,17 @@ class APIServer:
         pod.__dict__.pop(_SIG_MEMO, None)
         pod.metadata.resource_version = self._next_rv()
         store[(binding.pod_namespace, binding.pod_name)] = pod
-        return pod
+        return pod, True
 
     def bind(self, binding: Binding) -> Pod:
+        _api_unavailable_maybe()
         with self._lock:
-            pod = self._bind_locked(binding)
-            self._broadcast(
-                "Pod", WatchEvent(MODIFIED, pod, pod.metadata.resource_version)
-            )
+            pod, changed = self._bind_locked(binding)
+            if changed:
+                self._broadcast(
+                    "Pod",
+                    WatchEvent(MODIFIED, pod, pod.metadata.resource_version),
+                )
             return pod
 
     def bind_bulk(
@@ -416,15 +470,19 @@ class APIServer:
         mirroring N independent API calls minus N-1 lock round trips.
         Watch events for the whole transaction fan out in one bulk
         delivery per watcher."""
+        _api_unavailable_maybe()
         out: List[Tuple[Optional[Pod], Optional[Exception]]] = []
         events: List[WatchEvent] = []
         with self._lock:
             for binding in bindings:
                 try:
-                    pod = self._bind_locked(binding)
-                    events.append(
-                        WatchEvent(MODIFIED, pod, pod.metadata.resource_version)
-                    )
+                    pod, changed = self._bind_locked(binding)
+                    if changed:
+                        events.append(
+                            WatchEvent(
+                                MODIFIED, pod, pod.metadata.resource_version
+                            )
+                        )
                     out.append((pod, None))
                 except Exception as e:  # noqa: BLE001 - per-slot result
                     out.append((None, e))
@@ -442,6 +500,7 @@ class APIServer:
         bound. The whole transaction runs under one store lock with one
         bulk watch fan-out, through the native C loop when available
         (native/_hotpath.c bind_assumed_bulk)."""
+        _api_unavailable_maybe()
         with self._lock:
             if _bind_assumed_bulk is not None:
                 errors, events, new_rv = _bind_assumed_bulk(
@@ -451,12 +510,28 @@ class APIServer:
                 self._broadcast_many("Pod", events)
                 if not errors:
                     return []
+                store = self._stores["Pod"]
                 out: List[Tuple[int, Exception]] = []
                 for idx, code, msg in errors:
                     exc: Exception
                     if code == 0:
                         exc = NotFound(msg)
                     elif code == 1:
+                        # idempotent same-node re-bind (a retried commit
+                        # whose first attempt landed, or a restarted
+                        # scheduler re-driving a recovered placement):
+                        # the C loop reports it as a conflict, but the
+                        # store already holds exactly the requested state
+                        a = assumed_pods[idx]
+                        cur = store.get(
+                            (a.metadata.namespace, a.metadata.name)
+                        )
+                        if (
+                            cur is not None
+                            and cur.spec.node_name == a.spec.node_name
+                            and cur.metadata.uid == a.metadata.uid
+                        ):
+                            continue
                         exc = Conflict(msg)
                     elif code == 2:
                         exc = ValueError(msg)

@@ -17,7 +17,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api.types import Node, Pod
 from kubernetes_tpu.cache.node_info import NodeInfo, next_generation
@@ -232,6 +232,29 @@ class SchedulerCache:
     def pod_count(self) -> int:
         with self._lock:
             return sum(len(ni.pods) for ni in self._nodes.values())
+
+    # -- reconciliation support (scheduler/resilience.py) -------------------
+
+    def pod_states_snapshot(self) -> Dict[str, Tuple[Pod, bool]]:
+        """One consistent read of every cached pod: uid -> (pod,
+        assumed). The drift checker diffs this against a fresh apiserver
+        list; assumed entries are the scheduler's own optimistic overlay
+        and must never be "healed" away."""
+        with self._lock:
+            return {
+                uid: (state.pod, state.assumed)
+                for uid, state in self._pod_states.items()
+            }
+
+    def known_node_names(self) -> List[str]:
+        """Names of nodes the cache believes exist (entries kept only for
+        straggler pods -- node=None -- are excluded: they are pod
+        bookkeeping, not node state)."""
+        with self._lock:
+            return [
+                name for name, ni in self._nodes.items()
+                if ni.node is not None
+            ]
 
     # -- expiry (reference cleanupAssumedPods, run every 1s) ----------------
 

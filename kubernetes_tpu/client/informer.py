@@ -26,6 +26,7 @@ from kubernetes_tpu.apiserver.server import (
     ADDED,
     APIServer,
     DELETED,
+    Gone,
     MODIFIED,
     Watch,
     WatchEvent,
@@ -117,9 +118,29 @@ class Informer:
 
     # -- replication --------------------------------------------------------
 
+    def _list_watch_pair(self) -> Tuple[List[Any], int]:
+        """list + open a watch from the listed RV, with the 410 Gone
+        analogue handled: when the replay window was truncated past rv
+        (a write burst between list and watch, or the injected
+        watch_history_truncated point), list again from fresh state --
+        the reference Reflector's relist-on-410 (reflector.go:302)."""
+        last: Optional[Exception] = None
+        for _attempt in range(3):
+            objs, rv = self._server.list(self.kind)
+            try:
+                self._watch = self._server.watch(self.kind, since_rv=rv)
+                return objs, rv
+            except Gone as e:
+                metrics.watch_gone.inc(kind=self.kind)
+                logger.warning(
+                    "watch for %s got 410 Gone at rv %d; relisting",
+                    self.kind, rv,
+                )
+                last = e
+        raise last  # persistent Gone: caller's retry machinery takes over
+
     def _list_and_start_watch(self) -> None:
-        objs, rv = self._server.list(self.kind)
-        self._watch = self._server.watch(self.kind, since_rv=rv)
+        objs, rv = self._list_watch_pair()
         with self._lock:
             for obj in objs:
                 self._store[(obj.metadata.namespace, obj.metadata.name)] = obj
@@ -179,8 +200,7 @@ class Informer:
                 self._watch.stop()
             except Exception:  # noqa: BLE001 - old stream is already dead
                 pass
-        objs, rv = self._server.list(self.kind)
-        self._watch = self._server.watch(self.kind, since_rv=rv)
+        objs, rv = self._list_watch_pair()
         dispatch = []
         with self._lock:
             fresh = {
@@ -200,6 +220,9 @@ class Informer:
                     dispatch.append((MODIFIED, old, obj))
             self._store = fresh
         self._dispatch(dispatch)
+        # a relist that replaced a failed INITIAL sync leaves the
+        # informer fully caught up -- it is synced from here
+        self.synced = True
 
     def _next_events(self, timeout: Optional[float]) -> List[WatchEvent]:
         """One read from the watch stream, with the injected-drop seam
@@ -237,10 +260,22 @@ class Informer:
         self._needs_relist = False
         return True
 
+    def _initial_sync(self) -> None:
+        """First list+watch, resilient to a server that's briefly
+        unavailable (injected api_unavailable): arm the relist-retry flag
+        instead of letting the factory's start/pump crash."""
+        try:
+            self._list_and_start_watch()
+        except Exception:  # noqa: BLE001 - server down at startup
+            logger.exception(
+                "initial list+watch for %s failed; will retry", self.kind
+            )
+            self._needs_relist = True
+
     def pump(self) -> int:
         """Synchronously process pending events; returns count."""
         if self._watch is None:
-            self._list_and_start_watch()
+            self._initial_sync()
         evs = self._next_events(None)
         self._apply_batch(evs)
         return len(evs)
@@ -249,7 +284,7 @@ class Informer:
         if self._thread is not None:
             return
         if self._watch is None:
-            self._list_and_start_watch()
+            self._initial_sync()
 
         def run() -> None:
             while not self._stop.is_set():
@@ -336,10 +371,32 @@ class InformerFactory:
     def pump(self) -> int:
         return sum(inf.pump() for inf in self._informers.values())
 
-    def wait_for_cache_sync(self) -> None:
-        for inf in self._informers.values():
-            if not inf.synced:
-                inf.pump() if inf._thread is None else None
+    def wait_for_cache_sync(self, timeout: float = 30.0) -> bool:
+        """Block until every informer's initial sync completed (the
+        reference WaitForCacheSync contract). A failed initial
+        list+watch (server briefly unavailable) is retried here for
+        pump-mode informers and by the pump thread for threaded ones;
+        on timeout, log loudly and return False -- callers must not
+        assume a synced cache past a False return."""
+        deadline = time.time() + timeout
+        while True:
+            pending = [
+                inf for inf in self._informers.values() if not inf.synced
+            ]
+            if not pending:
+                return True
+            for inf in pending:
+                if inf._thread is None:
+                    inf.pump()
+            if all(inf.synced for inf in pending):
+                continue  # this round's pumps finished the job
+            if time.time() >= deadline:
+                logger.error(
+                    "caches never synced within %.0fs: %s",
+                    timeout, [inf.kind for inf in pending],
+                )
+                return False
+            time.sleep(0.01)
 
     def stop(self) -> None:
         for inf in self._informers.values():

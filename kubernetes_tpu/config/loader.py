@@ -22,6 +22,7 @@ from kubernetes_tpu.config.types import (
     Plugin,
     PluginSet,
     Plugins,
+    ResilienceConfiguration,
     RobustnessConfiguration,
     TPUSolverConfiguration,
 )
@@ -134,6 +135,10 @@ def load_config_from_dict(raw: Dict[str, Any]) -> KubeSchedulerConfiguration:
             retry_period_seconds=_duration_seconds(le_raw.get("retryPeriod", 2.0)),
             resource_name=le_raw.get("resourceName", "kube-scheduler"),
             resource_namespace=le_raw.get("resourceNamespace", "kube-system"),
+            renew_jitter_fraction=float(le_raw.get("renewJitter", 0.1)),
+            clock_skew_tolerance_seconds=_duration_seconds(
+                le_raw.get("clockSkewTolerance", 0.0)
+            ),
         ),
         health_bind_address=raw.get("healthzBindAddress", ""),
         metrics_bind_address=raw.get("metricsBindAddress", ""),
@@ -166,6 +171,17 @@ def load_config_from_dict(raw: Dict[str, Any]) -> KubeSchedulerConfiguration:
         retry_max_backoff_seconds=_duration_seconds(
             rb_raw.get("retryMaxBackoff", 1.0)
         ),
+    )
+    rs_raw = raw.get("resilience", {})
+    cfg.resilience = ResilienceConfiguration(
+        sweeper_enabled=bool(rs_raw.get("sweeperEnabled", True)),
+        sweep_interval_seconds=_duration_seconds(
+            rs_raw.get("sweepInterval", 1.0)
+        ),
+        drift_check_interval_seconds=_duration_seconds(
+            rs_raw.get("driftCheckInterval", 5.0)
+        ),
+        commit_fencing=bool(rs_raw.get("commitFencing", True)),
     )
     fi_raw = raw.get("faultInjection", {})
     cfg.fault_injection = FaultInjectionConfiguration(

@@ -100,6 +100,26 @@ class LeaderElectionConfiguration:
     retry_period_seconds: float = 2.0
     resource_name: str = "kube-scheduler"
     resource_namespace: str = "kube-system"
+    # PR-2 HA hardening (scheduler/leaderelection.py): retry periods are
+    # stretched by up to this fraction so candidates don't thunder in
+    # lockstep, and a challenger grants an expired holder this much
+    # extra grace before seizing (clock-skew tolerance)
+    renew_jitter_fraction: float = 0.1
+    clock_skew_tolerance_seconds: float = 0.0
+
+
+@dataclass
+class ResilienceConfiguration:
+    """Control-plane resilience knobs (scheduler/resilience.py): the
+    assumed-pod TTL sweeper, the cache<->apiserver drift checker, and
+    commit-time lease fencing."""
+
+    #: gates the WHOLE reconciler thread: assumed-pod TTL expiry AND the
+    #: drift checker (they share one sweep loop); False disables both
+    sweeper_enabled: bool = True
+    sweep_interval_seconds: float = 1.0  # reference cleanupAssumedPods cadence
+    drift_check_interval_seconds: float = 5.0
+    commit_fencing: bool = True
 
 
 @dataclass
@@ -172,6 +192,9 @@ class KubeSchedulerConfiguration:
     )
     robustness: RobustnessConfiguration = field(
         default_factory=RobustnessConfiguration
+    )
+    resilience: ResilienceConfiguration = field(
+        default_factory=ResilienceConfiguration
     )
     fault_injection: FaultInjectionConfiguration = field(
         default_factory=FaultInjectionConfiguration

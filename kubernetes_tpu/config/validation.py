@@ -46,6 +46,14 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
             )
         if not le.resource_name:
             errors.append("leaderElection.resourceName is required")
+        if not 0.0 <= le.renew_jitter_fraction <= 1.0:
+            errors.append(
+                "leaderElection.renewJitter must be in [0, 1]"
+            )
+        if le.clock_skew_tolerance_seconds < 0:
+            errors.append(
+                "leaderElection.clockSkewTolerance must be >= 0"
+            )
 
     # profiles: unique scheduler names; all share one queue sort
     # (profile.go:120 validation)
@@ -106,6 +114,13 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
             errors.append("robustness.retryMaxAttempts must be >= 1")
         if rb.retry_backoff_seconds < 0:
             errors.append("robustness.retryBackoff must be >= 0")
+
+    rs = getattr(cfg, "resilience", None)
+    if rs is not None:
+        if rs.sweep_interval_seconds <= 0:
+            errors.append("resilience.sweepInterval must be positive")
+        if rs.drift_check_interval_seconds <= 0:
+            errors.append("resilience.driftCheckInterval must be positive")
 
     fi = getattr(cfg, "fault_injection", None)
     if fi is not None and fi.enabled:

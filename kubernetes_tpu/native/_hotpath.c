@@ -497,6 +497,20 @@ bind_assumed_bulk(PyObject *self, PyObject *args)
                 errfmt = "pod %U/%U is already bound";
                 goto slot_error;
             }
+            /* already bound to the SAME node: idempotent success (a
+             * retried commit whose first attempt landed, or a restarted
+             * scheduler re-driving a recovered placement) -- the store
+             * already holds exactly the requested state, so no write,
+             * no rv bump, no event (parity: _bind_locked changed=False) */
+            Py_DECREF(old_nn);
+            Py_DECREF(old_spec);
+            Py_DECREF(old_meta);
+            Py_DECREF(key);
+            Py_DECREF(ns);
+            Py_DECREF(name);
+            Py_DECREF(uid);
+            Py_DECREF(target);
+            continue;
         } else if (bound < 0) {
             Py_DECREF(old_nn);
             Py_DECREF(old_spec);

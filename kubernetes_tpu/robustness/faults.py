@@ -40,10 +40,23 @@ class FaultPoint:
     BIND_CONFLICT = "bind_conflict"
     #: watch stream drops mid-frame (informer must relist)
     WATCH_DROP = "watch_drop"
+    #: lease renew/acquire RPC fails (leader election must jitter-retry
+    #: and, past the renew deadline, abdicate)
+    LEASE_RENEW_FAIL = "lease_renew_fail"
+    #: apiserver transaction fails outright (list/bind/guaranteed_update
+    #: raise; retry policies and relist must absorb it)
+    API_UNAVAILABLE = "api_unavailable"
+    #: the scheduler process dies between assume and bind (no cleanup
+    #: runs; the restarted incarnation must requeue the in-flight pods)
+    CRASH_BETWEEN_ASSUME_AND_BIND = "crash_between_assume_and_bind"
+    #: the watch replay window no longer covers since_rv (410 Gone
+    #: analogue; the informer must relist + diff)
+    WATCH_HISTORY_TRUNCATED = "watch_history_truncated"
 
     ALL = (
         DEVICE_SOLVE, DEVICE_SOLVE_HANG, SOLVE_GARBAGE, BIND_CONFLICT,
-        WATCH_DROP,
+        WATCH_DROP, LEASE_RENEW_FAIL, API_UNAVAILABLE,
+        CRASH_BETWEEN_ASSUME_AND_BIND, WATCH_HISTORY_TRUNCATED,
     )
 
 
@@ -54,6 +67,18 @@ class FaultInjected(Exception):
     def __init__(self, point: str) -> None:
         super().__init__(f"injected fault at {point!r}")
         self.point = point
+
+
+class SchedulerCrashed(Exception):
+    """Raised by the CRASH_BETWEEN_ASSUME_AND_BIND point: the process is
+    'dead' from here -- the handlers that catch this MUST NOT run the
+    normal failure cleanup (forget/Unreserve/requeue), because a real
+    crash wouldn't; recovery is the next incarnation's job."""
+
+    def __init__(self) -> None:
+        super().__init__(
+            "injected crash between assume and bind (no cleanup runs)"
+        )
 
 
 @dataclass
@@ -132,6 +157,13 @@ class FaultInjector:
         if self.should_fire(point):
             raise FaultInjected(point)
 
+    def crash_maybe(self, point: str) -> None:
+        """Raise SchedulerCrashed when the point fires. Distinct from
+        raise_maybe: the catcher must treat it as process death (halt,
+        no cleanup), not as a retryable failure."""
+        if self.should_fire(point):
+            raise SchedulerCrashed()
+
     def hang_seconds_maybe(self, point: str) -> float:
         """Seconds the seam should block for (0.0 = no fault). The caller
         sleeps inside whatever watchdog scope guards the real operation,
@@ -209,6 +241,27 @@ def builtin_profiles() -> Dict[str, FaultProfile]:
             name="flaky-watch",
             seed=0,
             points={FaultPoint.WATCH_DROP: PointConfig(rate=0.05)},
+        ),
+        # control-plane chaos (PR-2 acceptance shape): renew failures
+        # that force a failover, transient API unavailability absorbed
+        # by retries/relists, a truncated watch window (410 Gone), and a
+        # bind-conflict burst -- every point heals after a bounded
+        # number of fires so the run converges
+        "ha-chaos": FaultProfile(
+            name="ha-chaos",
+            seed=0,
+            points={
+                FaultPoint.LEASE_RENEW_FAIL: PointConfig(
+                    rate=0.3, max_fires=8
+                ),
+                FaultPoint.API_UNAVAILABLE: PointConfig(
+                    rate=0.05, max_fires=10
+                ),
+                FaultPoint.WATCH_HISTORY_TRUNCATED: PointConfig(
+                    rate=0.5, max_fires=2
+                ),
+                FaultPoint.BIND_CONFLICT: PointConfig(rate=1.0, max_fires=2),
+            },
         ),
     }
 

@@ -20,6 +20,10 @@ from kubernetes_tpu.client.informer import InformerFactory
 from kubernetes_tpu.config.types import KubeSchedulerConfiguration
 from kubernetes_tpu.scheduler.debugger import CacheDebugger
 from kubernetes_tpu.scheduler.leaderelection import LeaderElector
+from kubernetes_tpu.scheduler.resilience import (
+    ControlPlaneReconciler,
+    recover_on_startup,
+)
 from kubernetes_tpu.scheduler.scheduler import Scheduler, new_scheduler
 from kubernetes_tpu.utils import metrics
 
@@ -108,6 +112,8 @@ class SchedulerApp:
             snapshot=self.sched.algorithm.snapshot,
         )
         self.elector: Optional[LeaderElector] = None
+        self.reconciler: Optional[ControlPlaneReconciler] = None
+        self.recovery_report = None
         self._http: Optional[ThreadingHTTPServer] = None
         self._threads = []
 
@@ -128,11 +134,24 @@ class SchedulerApp:
     def start(self) -> None:
         self.informers.start()
         self.informers.wait_for_cache_sync()
+        # Crash recovery (scheduler/resilience.py): the relist above
+        # rebuilt cache/queue; verify it against apiserver ground truth,
+        # adopt anything a previous incarnation bound, and meter it.
+        self.recovery_report = recover_on_startup(self.sched, self.client)
         # Freeze the synced cluster graph out of cyclic-GC scanning
         # (utils/gc_tuning.py rationale).
         from kubernetes_tpu.utils.gc_tuning import freeze_steady_state_graph
 
         freeze_steady_state_graph()
+        rs = self.config.resilience
+        if rs.sweeper_enabled:
+            self.reconciler = ControlPlaneReconciler(
+                self.sched,
+                self.client,
+                sweep_interval=rs.sweep_interval_seconds,
+                drift_interval=rs.drift_check_interval_seconds,
+            )
+            self.reconciler.start()
         if self.config.leader_election.leader_elect:
             self.elector = LeaderElector(
                 self.client,
@@ -141,6 +160,10 @@ class SchedulerApp:
                 on_started_leading=lambda: self.sched.run(),
                 on_stopped_leading=self.sched.stop,
             )
+            if rs.commit_fencing:
+                # commit-time fencing: the committer re-verifies lease
+                # ownership immediately before every bulk bind
+                self.sched.fencing_check = self.elector.holds_lease
             t = threading.Thread(target=self.elector.run, daemon=True)
             t.start()
             self._threads.append(t)
@@ -148,6 +171,8 @@ class SchedulerApp:
             self.sched.start()
 
     def stop(self) -> None:
+        if self.reconciler is not None:
+            self.reconciler.stop()
         if self.elector is not None:
             self.elector.stop()
             self.elector.release()

@@ -77,7 +77,11 @@ from kubernetes_tpu.ops.topology import (
     pad_spread_tensors,
 )
 from kubernetes_tpu.robustness.circuit import SolveTimeout
-from kubernetes_tpu.robustness.faults import FaultPoint, get_injector
+from kubernetes_tpu.robustness.faults import (
+    FaultPoint,
+    SchedulerCrashed,
+    get_injector,
+)
 from kubernetes_tpu.robustness.ladder import (
     LadderExhausted,
     RobustnessConfig,
@@ -380,6 +384,8 @@ class BatchScheduler(Scheduler):
                 if pending is None:
                     return
             self._complete_solve(pending)
+        except SchedulerCrashed:
+            self._simulate_crash()  # no recovery: the process "died"
         except Exception:
             # a failed download/commit must not crash the dispatch loop:
             # requeue the batch's pods (they retry on whatever tier the
@@ -568,6 +574,8 @@ class BatchScheduler(Scheduler):
             try:
                 p["committing"] = True
                 self._complete_solve(p)
+            except SchedulerCrashed:
+                self._simulate_crash()  # no recovery: the process "died"
             except Exception:
                 logger.exception("batch commit crashed")
                 self._recover_failed_batch(p)
@@ -1810,6 +1818,10 @@ class BatchScheduler(Scheduler):
     ) -> None:
         try:
             self._bulk_binding_cycle(items, pod_scheduling_cycle, snapshot)
+        except SchedulerCrashed:
+            # simulated process death: halt with NO cleanup (the items
+            # stay assumed-but-unbound; the next incarnation recovers)
+            self._simulate_crash()
         except Exception:
             logger.exception("bulk binding cycle crashed")
         finally:
@@ -1867,6 +1879,33 @@ class BatchScheduler(Scheduler):
                 return
         else:
             ready = items
+        inj = get_injector()
+        if inj is not None:
+            # the whole bulk is assumed but not yet bound -- the window
+            # a process death strands (restart e2e drives this point)
+            inj.crash_maybe(FaultPoint.CRASH_BETWEEN_ASSUME_AND_BIND)
+        # commit-time lease fencing: verify ownership IMMEDIATELY before
+        # the bulk transaction. A deposed leader (failed renews, standby
+        # already holds the lease) must not commit placements computed
+        # under its stale view -- abort and requeue; the pods are already
+        # in the new leader's queue via its informers.
+        if not self._fence_ok():
+            metrics.fencing_aborts.inc()
+            logger.warning(
+                "lease lost before bulk bind; fencing %d pod(s)",
+                len(ready),
+            )
+            for prof, state, pi, assumed, host in ready:
+                self._forget(assumed)
+                prof.run_unreserve_plugins(
+                    state if state is not None else mk_state(),
+                    assumed, host,
+                )
+                self.record_scheduling_failure(
+                    prof, pi, "lease lost before commit; fenced",
+                    "SchedulerError", "", pod_scheduling_cycle,
+                )
+            return
         assumed_list = [t[3] for t in ready]
         bind_timer = metrics.SinceTimer(metrics.binding_duration)
         with timeline.span("bind_bulk"):

@@ -33,7 +33,11 @@ from kubernetes_tpu.framework.runtime import Framework
 from kubernetes_tpu.plugins import new_in_tree_registry
 from kubernetes_tpu.queue.scheduling_queue import PriorityQueue
 from kubernetes_tpu.robustness.circuit import RetryPolicy
-from kubernetes_tpu.robustness.faults import FaultPoint, get_injector
+from kubernetes_tpu.robustness.faults import (
+    FaultPoint,
+    SchedulerCrashed,
+    get_injector,
+)
 from kubernetes_tpu.scheduler.generic import GenericScheduler
 from kubernetes_tpu.scheduler.provider import default_plugins
 from kubernetes_tpu.utils import metrics
@@ -73,6 +77,14 @@ class Scheduler:
         # guarantees forget + Unreserve + requeue)
         self.bind_retry_policy = RetryPolicy()
         self._retry_sleep = time.sleep
+        # commit-time lease fencing (PR-2 HA): when set (SchedulerApp
+        # wires LeaderElector.holds_lease), every commit verifies lease
+        # ownership immediately before binding and aborts + requeues when
+        # deposed -- two live schedulers can never double-bind
+        self.fencing_check: Optional[Callable[[], bool]] = None
+        # set when an injected crash_between_assume_and_bind fired: the
+        # process is "dead" -- the loop halts and NO cleanup runs
+        self.crashed = False
 
     # -- profile lookup (scheduler.go:741 profileForPod) --------------------
 
@@ -161,9 +173,30 @@ class Scheduler:
 
     # -- bind (scheduler.go:496) --------------------------------------------
 
+    def _fence_ok(self) -> bool:
+        """True when this scheduler may commit (no fencing configured, or
+        the lease is verifiably still held). A False answer means the
+        caller must abort the commit; the normal failure path then
+        guarantees forget + Unreserve + requeue, and the pods land on
+        whoever holds the lease now (their informers already queue
+        them)."""
+        check = self.fencing_check
+        if check is None:
+            return True
+        try:
+            return bool(check())
+        except Exception:  # noqa: BLE001 - can't prove ownership: fence
+            logger.exception("fencing check failed; aborting commit")
+            return False
+
     def bind(
         self, prof: Framework, state: CycleState, assumed: Pod, host: str
     ) -> Optional[Status]:
+        if not self._fence_ok():
+            metrics.fencing_aborts.inc()
+            return Status.error(
+                "lease lost before bind; commit fenced"
+            )
         for extender in self.algorithm.extenders:
             if extender.is_binder() and extender.is_interested(assumed):
                 try:
@@ -373,12 +406,27 @@ class Scheduler:
     def _binding_cycle_safe(self, *args) -> None:
         try:
             self._binding_cycle(*args)
+        except SchedulerCrashed:
+            self._simulate_crash()
         except Exception:
             logger.exception("binding cycle crashed")
         finally:
             with self._inflight_lock:
                 self._inflight_binds -= 1
                 self._inflight_lock.notify_all()
+
+    def _simulate_crash(self) -> None:
+        """The crash_between_assume_and_bind point fired: the process is
+        dead from here. Halt the scheduling loop and run NO cleanup --
+        the assumed pod stays assumed, nothing is requeued; recovery is
+        the next incarnation's job (it relists, adopts bound pods, and
+        requeues the in-flight ones)."""
+        logger.error(
+            "injected crash between assume and bind; halting scheduler "
+            "with no cleanup"
+        )
+        self.crashed = True
+        self._stop.set()
 
     def _binding_cycle(
         self,
@@ -412,6 +460,11 @@ class Scheduler:
             )
             return
 
+        inj = get_injector()
+        if inj is not None:
+            # the pod is assumed but not yet bound: exactly the window a
+            # process death strands (restart e2e drives this point)
+            inj.crash_maybe(FaultPoint.CRASH_BETWEEN_ASSUME_AND_BIND)
         bind_timer = metrics.SinceTimer(metrics.binding_duration)
         status = self.bind(prof, state, assumed, host)
         bind_timer.observe()
@@ -610,6 +663,12 @@ def new_scheduler(
             client=client,
             async_binding=async_binding,
         )
+        if robustness_config is not None:
+            # the sequential path has no ladder, but its bind retries
+            # must still honor the configured policy (the batch path
+            # inherits it from the ladder's config)
+            sched.bind_retry_policy = robustness_config.retry
+            sched._retry_sleep = robustness_config.sleep
     from kubernetes_tpu.scheduler.eventhandlers import add_all_event_handlers
     from kubernetes_tpu.scheduler.preemption import Preemptor
 

@@ -296,6 +296,45 @@ watch_relists = registry.register(Counter(
     "Informer relists forced by a broken watch stream, by kind.",
     ("kind",),
 ))
+# control-plane resilience (PR 2): crash recovery, fenced HA failover,
+# cache<->apiserver reconciliation -- failover and restart must be as
+# observable as a solver fault
+fencing_aborts = registry.register(Counter(
+    "scheduler_fencing_aborts_total",
+    "Commits aborted because the lease was no longer held at commit "
+    "time (the deposed-leader double-bind guard).",
+))
+lease_renew_failures = registry.register(Counter(
+    "scheduler_lease_renew_failures_total",
+    "Failed lease acquire/renew rounds (API error or injected).",
+))
+assumed_pods_expired = registry.register(Counter(
+    "scheduler_assumed_pods_expired_total",
+    "Assumed pods expired by the TTL sweeper (binding finished but the "
+    "watch confirmation never arrived).",
+))
+cache_drift = registry.register(Counter(
+    "scheduler_cache_drift_total",
+    "Cache<->apiserver divergences detected and healed by the drift "
+    "checker, by object kind and healing action.",
+    ("kind", "action"),
+))
+pods_adopted_on_restart = registry.register(Counter(
+    "scheduler_pods_adopted_on_restart_total",
+    "Pods found already bound by a previous incarnation and adopted "
+    "into the cache at startup.",
+))
+pods_requeued_on_restart = registry.register(Counter(
+    "scheduler_pods_requeued_on_restart_total",
+    "Pending pods (including a previous incarnation's in-flight "
+    "assume-but-never-bound pods) requeued at startup.",
+))
+watch_gone = registry.register(Counter(
+    "scheduler_watch_gone_total",
+    "Watch opens rejected with the 410 Gone analogue (replay window "
+    "truncated past since_rv), by kind.",
+    ("kind",),
+))
 commit_join_timeouts = registry.register(Counter(
     "scheduler_commit_thread_join_timeouts_total",
     "Committer threads that failed to join at shutdown.",

@@ -1,0 +1,184 @@
+"""Fenced HA failover e2e (PR-2 acceptance): two SchedulerApp instances
+leader-elected over one apiserver. The leader is killed mid-burst (its
+renews fail permanently via a targeted lease_renew_fail injector). While
+it is deposed-but-live (lease expired, renew deadline not yet passed) it
+keeps dispatching -- and every commit it attempts hits the commit-time
+fence (lease ownership verified immediately before every bulk bind) and
+aborts + requeues instead of binding. The standby then seizes the lease
+and drains the backlog: 100% of pods bound, every pod EXACTLY once
+(asserted against the apiserver's full watch history), fencing aborts
+visible in metrics."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.config.types import (
+    KubeSchedulerConfiguration,
+    LeaderElectionConfiguration,
+)
+from kubernetes_tpu.robustness.faults import (
+    FaultInjector,
+    FaultPoint,
+    FaultProfile,
+    PointConfig,
+    install_injector,
+)
+from kubernetes_tpu.scheduler.app import SchedulerApp
+from kubernetes_tpu.testing import make_node, make_pod
+from kubernetes_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    yield
+    install_injector(None)
+
+
+def _wait(predicate, timeout, step=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(step)
+    return predicate()
+
+
+def _le_config():
+    return KubeSchedulerConfiguration(
+        leader_election=LeaderElectionConfiguration(
+            leader_elect=True,
+            lease_duration_seconds=0.5,
+            # deliberately > leaseDuration: the deposed leader stays
+            # LIVE for seconds after its lease expires -- the fencing
+            # window a real deployment's clock/renew skew opens,
+            # compressed
+            renew_deadline_seconds=6.0,
+            retry_period_seconds=0.05,
+        )
+    )
+
+
+def _bound_count(client, names):
+    pods, _ = client.list_pods()
+    return sum(
+        1 for p in pods if p.spec.node_name and p.metadata.name in names
+    )
+
+
+def _bind_transitions(server):
+    """Per-pod count of unbound->bound transitions replayed from the
+    full watch history -- the ground-truth zero-double-bind assertion."""
+    w = server.watch("Pod", since_rv=0)
+    node = {}
+    transitions = {}
+    for ev in w.pending():
+        pod = ev.object
+        name = pod.metadata.name
+        prev = node.get(name, "")
+        cur = pod.spec.node_name or ""
+        if ev.type == "DELETED":
+            node.pop(name, None)
+            continue
+        if not prev and cur:
+            transitions[name] = transitions.get(name, 0) + 1
+        node[name] = cur
+    w.stop()
+    return transitions
+
+
+def test_leader_killed_mid_batch_standby_drains_with_fencing():
+    server = APIServer()
+    app1 = SchedulerApp(config=_le_config(), server=server)
+    client = app1.client
+    for i in range(16):
+        client.create_node(
+            make_node(f"n{i}").capacity(cpu="32", memory="64Gi", pods=110).obj()
+        )
+
+    app1.start()
+    assert _wait(lambda: app1.elector.is_leader, 10), "no initial leader"
+    # fencing is wired: the committer verifies the lease per bulk bind
+    assert app1.sched.fencing_check is not None
+
+    wave1 = [f"p{i}" for i in range(160)]
+    for n in wave1:
+        client.create_pod(
+            make_pod(n).container(cpu="100m", memory="128Mi").obj()
+        )
+    # the leader is mid-burst when the kill lands
+    assert _wait(lambda: _bound_count(client, set(wave1)) >= 20, 90), (
+        "leader never made progress"
+    )
+
+    fences0 = metrics.fencing_aborts.value()
+    renew0 = metrics.lease_renew_failures.value()
+    # the kill: every subsequent renew by the leader fails (targeted
+    # injector -- a standby's elector would stay healthy)
+    t_kill = time.perf_counter()
+    app1.elector.fault_injector = FaultInjector(FaultProfile(
+        "leader-kill", seed=0,
+        points={FaultPoint.LEASE_RENEW_FAIL: PointConfig(rate=1.0)},
+    ))
+    # wave 1 still finishes: commits that happen while the lease is
+    # still live are legitimate
+    assert _wait(lambda: not app1.elector.holds_lease(), 15), (
+        "lease never expired after the kill"
+    )
+    assert metrics.lease_renew_failures.value() > renew0
+    assert app1.elector.is_leader, (
+        "leader abdicated before the renew deadline -- no fencing window"
+    )
+
+    # -- the fencing window: deposed-but-live leader, no standby yet ----
+    # It is the ONLY live scheduler, its loop still dispatches, and
+    # every commit must hit the fence: abort + requeue, nothing binds.
+    wave2 = [f"q{i}" for i in range(64)]
+    for n in wave2:
+        client.create_pod(
+            make_pod(n).container(cpu="100m", memory="128Mi").obj()
+        )
+    assert _wait(
+        lambda: metrics.fencing_aborts.value() > fences0, 20
+    ), "deposed leader never hit the fence"
+    assert _bound_count(client, set(wave2)) == 0, (
+        "a deposed leader committed binds past the fence"
+    )
+
+    # -- failover: the standby seizes the expired lease and drains ------
+    app2 = SchedulerApp(config=_le_config(), server=server)
+    app2.start()
+    assert _wait(lambda: app2.elector.is_leader, 20), (
+        "standby never took over"
+    )
+    takeover_s = time.perf_counter() - t_kill
+    nameset = set(wave1) | set(wave2)
+    assert _wait(lambda: _bound_count(client, nameset) == 224, 120), (
+        f"only {_bound_count(client, nameset)}/224 bound after failover"
+    )
+    # the deposed leader abdicates once its renew deadline passes
+    assert _wait(lambda: not app1.elector.is_leader, 30), (
+        "deposed leader never abdicated"
+    )
+    assert takeover_s < 60
+
+    app1.sched.wait_for_inflight_binds()
+    app2.sched.wait_for_inflight_binds()
+
+    # zero double-binds, asserted against apiserver state: every pod has
+    # exactly one unbound->bound transition in the full watch history
+    transitions = _bind_transitions(server)
+    assert sorted(transitions) == sorted(nameset)
+    assert all(v == 1 for v in transitions.values()), {
+        k: v for k, v in transitions.items() if v != 1
+    }
+    pods, _ = client.list_pods()
+    per_node = {}
+    for p in pods:
+        assert p.spec.node_name, f"{p.metadata.name} unbound"
+        per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+    assert all(v <= 110 for v in per_node.values())
+
+    app2.stop()
+    app1.stop()

@@ -1,0 +1,308 @@
+"""LeaderElector edge cases (PR-2 satellite): renew attempted past the
+lease deadline, release() semantics, two electors contending on one
+lease, clock-skew tolerance, jittered renew, and the commit-time fencing
+probe (holds_lease)."""
+
+import threading
+import time
+
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.config.types import LeaderElectionConfiguration
+from kubernetes_tpu.robustness.faults import (
+    FaultInjector,
+    FaultPoint,
+    FaultProfile,
+    PointConfig,
+)
+from kubernetes_tpu.scheduler.leaderelection import LeaderElector
+from kubernetes_tpu.utils import metrics
+
+
+def _elector(client, name, cfg, events=None, clock=time.monotonic):
+    events = events if events is not None else []
+    return LeaderElector(
+        client,
+        cfg,
+        identity=name,
+        on_started_leading=lambda: events.append(("lead", name)),
+        on_stopped_leading=lambda: events.append(("stop", name)),
+        clock=clock,
+    )
+
+
+def _renew_killer(seed=0):
+    """Targeted injector: every renew/acquire round fails."""
+    return FaultInjector(FaultProfile(
+        "kill-renew", seed=seed,
+        points={FaultPoint.LEASE_RENEW_FAIL: PointConfig(rate=1.0)},
+    ))
+
+
+class TestRenewDeadline:
+    def test_renew_failures_past_deadline_abdicate(self):
+        """The holder's renews fail (injected lease_renew_fail); once the
+        renew deadline passes it must abdicate: on_stopped_leading fires,
+        is_leader drops, and the failures are metered."""
+        server = APIServer()
+        client = Client(server)
+        cfg = LeaderElectionConfiguration(
+            leader_elect=True,
+            lease_duration_seconds=0.4,
+            renew_deadline_seconds=0.3,
+            retry_period_seconds=0.03,
+        )
+        events = []
+        a = _elector(client, "a", cfg, events)
+        before = metrics.lease_renew_failures.value()
+        t = threading.Thread(target=a.run, daemon=True)
+        t.start()
+        deadline = time.time() + 3
+        while not a.is_leader and time.time() < deadline:
+            time.sleep(0.01)
+        assert a.is_leader
+        # kill every subsequent renew, targeted at this elector only
+        a.fault_injector = _renew_killer()
+        t.join(timeout=5)
+        assert not t.is_alive(), "elector never abdicated"
+        assert not a.is_leader
+        assert ("stop", "a") in events
+        assert metrics.lease_renew_failures.value() > before
+
+    def test_renew_failure_before_deadline_keeps_leading(self):
+        """A transient renew failure inside the deadline must NOT
+        abdicate: the next successful round re-extends the deadline."""
+        server = APIServer()
+        client = Client(server)
+        cfg = LeaderElectionConfiguration(
+            leader_elect=True,
+            lease_duration_seconds=2.0,
+            renew_deadline_seconds=1.5,
+            retry_period_seconds=0.03,
+        )
+        a = _elector(client, "a", cfg)
+        a.fault_injector = FaultInjector(FaultProfile(
+            "flaky-renew", seed=7,
+            points={
+                FaultPoint.LEASE_RENEW_FAIL: PointConfig(
+                    rate=0.5, max_fires=5
+                )
+            },
+        ))
+        t = threading.Thread(target=a.run, daemon=True)
+        t.start()
+        deadline = time.time() + 3
+        while not a.is_leader and time.time() < deadline:
+            time.sleep(0.01)
+        assert a.is_leader
+        time.sleep(0.5)  # several renew rounds, some failing
+        assert a.is_leader, "transient renew failures must not depose"
+        a.stop()
+        t.join(timeout=2)
+
+
+class TestRelease:
+    def test_release_clears_holder_identity(self):
+        server = APIServer()
+        client = Client(server)
+        cfg = LeaderElectionConfiguration(
+            lease_duration_seconds=30.0,
+            renew_deadline_seconds=10.0,
+            retry_period_seconds=0.05,
+        )
+        a = _elector(client, "a", cfg)
+        assert a._try_acquire_or_renew()
+        a.is_leader = True
+        a.release()
+        lease = server.get("Lease", "kube-system", "kube-scheduler")
+        assert lease.holder_identity == ""
+        assert not a.is_leader
+
+    def test_release_when_not_leader_is_noop(self):
+        """release() by a non-holder must not clobber someone else's
+        live lease."""
+        server = APIServer()
+        client = Client(server)
+        cfg = LeaderElectionConfiguration(
+            lease_duration_seconds=30.0,
+            renew_deadline_seconds=10.0,
+            retry_period_seconds=0.05,
+        )
+        a = _elector(client, "a", cfg)
+        b = _elector(client, "b", cfg)
+        assert a._try_acquire_or_renew()
+        a.is_leader = True
+        b.release()  # never led
+        assert server.get(
+            "Lease", "kube-system", "kube-scheduler"
+        ).holder_identity == "a"
+        # stale is_leader flag but the lease moved on: still a no-op
+        b.is_leader = True
+        a.release()
+        assert a._try_acquire_or_renew()  # lease is free again
+        a.is_leader = True
+        b.release()
+        assert server.get(
+            "Lease", "kube-system", "kube-scheduler"
+        ).holder_identity == "a", "non-holder release clobbered the lease"
+
+
+class TestContention:
+    def test_two_electors_one_lease_single_winner(self):
+        """Both candidates CAS against one lease record: exactly one
+        wins every round, and the loser never flips is_leader."""
+        server = APIServer()
+        client = Client(server)
+        cfg = LeaderElectionConfiguration(
+            leader_elect=True,
+            lease_duration_seconds=0.6,
+            renew_deadline_seconds=0.5,
+            retry_period_seconds=0.02,
+        )
+        a = _elector(client, "a", cfg)
+        b = _elector(client, "b", cfg)
+        ta = threading.Thread(target=a.run, daemon=True)
+        tb = threading.Thread(target=b.run, daemon=True)
+        ta.start()
+        tb.start()
+        deadline = time.time() + 3
+        while not (a.is_leader or b.is_leader) and time.time() < deadline:
+            time.sleep(0.01)
+        # sample repeatedly: never both
+        for _ in range(20):
+            assert not (a.is_leader and b.is_leader)
+            time.sleep(0.02)
+        lease = server.get("Lease", "kube-system", "kube-scheduler")
+        assert lease.holder_identity in ("a", "b")
+        assert lease.lease_transitions == 1  # exactly one acquisition
+        a.stop()
+        b.stop()
+        ta.join(timeout=2)
+        tb.join(timeout=2)
+
+    def test_direct_cas_only_one_seizes(self):
+        """The holder/expiry check runs inside the atomic update: a
+        second candidate's CAS against a live lease loses."""
+        server = APIServer()
+        client = Client(server)
+        cfg = LeaderElectionConfiguration(
+            lease_duration_seconds=10.0,
+            renew_deadline_seconds=5.0,
+            retry_period_seconds=0.05,
+        )
+        a = _elector(client, "a", cfg)
+        b = _elector(client, "b", cfg)
+        assert a._try_acquire_or_renew()
+        assert not b._try_acquire_or_renew()
+        # the holder renews fine against its own record
+        assert a._try_acquire_or_renew()
+
+
+class TestClockSkewTolerance:
+    def _pair(self, skew_tolerance):
+        server = APIServer()
+        client = Client(server)
+        t_a = [0.0]
+        t_b = [0.0]
+        cfg_a = LeaderElectionConfiguration(
+            lease_duration_seconds=10.0,
+            renew_deadline_seconds=5.0,
+            retry_period_seconds=0.05,
+        )
+        cfg_b = LeaderElectionConfiguration(
+            lease_duration_seconds=10.0,
+            renew_deadline_seconds=5.0,
+            retry_period_seconds=0.05,
+            clock_skew_tolerance_seconds=skew_tolerance,
+        )
+        a = _elector(client, "a", cfg_a, clock=lambda: t_a[0])
+        b = _elector(client, "b", cfg_b, clock=lambda: t_b[0])
+        return a, b, t_a, t_b
+
+    def test_challenger_grants_skew_grace(self):
+        """A challenger whose clock runs slightly ahead must not seize a
+        lease the holder still believes is live: seizure waits out
+        lease_duration + clockSkewTolerance."""
+        a, b, t_a, t_b = self._pair(skew_tolerance=1.0)
+        assert a._try_acquire_or_renew()  # renew_time = 0, duration 10
+        t_b[0] = 10.2  # past expiry by b's (skewed) clock, inside grace
+        assert not b._try_acquire_or_renew()
+        t_b[0] = 11.2  # past expiry + tolerance: now seize
+        assert b._try_acquire_or_renew()
+
+    def test_zero_tolerance_seizes_at_expiry(self):
+        a, b, t_a, t_b = self._pair(skew_tolerance=0.0)
+        assert a._try_acquire_or_renew()
+        t_b[0] = 10.2
+        assert b._try_acquire_or_renew()
+
+
+class TestJitter:
+    def test_jitter_stretches_within_fraction(self):
+        server = APIServer()
+        client = Client(server)
+        cfg = LeaderElectionConfiguration(
+            retry_period_seconds=1.0, renew_jitter_fraction=0.25
+        )
+        a = _elector(client, "a", cfg)
+        samples = [a._jittered(1.0) for _ in range(200)]
+        assert all(1.0 <= s <= 1.25 for s in samples)
+        assert len(set(samples)) > 1, "jitter stream is constant"
+
+    def test_zero_jitter_is_exact(self):
+        server = APIServer()
+        client = Client(server)
+        cfg = LeaderElectionConfiguration(renew_jitter_fraction=0.0)
+        a = _elector(client, "a", cfg)
+        assert a._jittered(1.0) == 1.0
+
+
+class TestFencingProbe:
+    def test_holds_lease_tracks_ownership(self):
+        server = APIServer()
+        client = Client(server)
+        cfg = LeaderElectionConfiguration(
+            lease_duration_seconds=10.0,
+            renew_deadline_seconds=5.0,
+            retry_period_seconds=0.05,
+        )
+        t = [0.0]
+        a = _elector(client, "a", cfg, clock=lambda: t[0])
+        b = _elector(client, "b", cfg, clock=lambda: t[0])
+        assert a._try_acquire_or_renew()
+        a.is_leader = True
+        assert a.holds_lease()
+        # lease expires and the standby seizes it: the old holder's
+        # fresh read must answer False even though is_leader is stale
+        t[0] = 10.5
+        assert b._try_acquire_or_renew()
+        b.is_leader = True
+        assert not a.holds_lease()
+        assert b.holds_lease()
+
+    def test_holds_lease_false_on_expired_record(self):
+        server = APIServer()
+        client = Client(server)
+        cfg = LeaderElectionConfiguration(
+            lease_duration_seconds=10.0,
+            renew_deadline_seconds=5.0,
+            retry_period_seconds=0.05,
+        )
+        t = [0.0]
+        a = _elector(client, "a", cfg, clock=lambda: t[0])
+        assert a._try_acquire_or_renew()
+        a.is_leader = True
+        t[0] = 10.5  # past expiry with no renew: can't prove ownership
+        assert not a.holds_lease()
+
+    def test_holds_lease_false_when_record_missing(self):
+        server = APIServer()
+        client = Client(server)
+        cfg = LeaderElectionConfiguration(
+            lease_duration_seconds=10.0,
+            renew_deadline_seconds=5.0,
+            retry_period_seconds=0.05,
+        )
+        a = _elector(client, "a", cfg)
+        a.is_leader = True  # believes it leads but no record exists
+        assert not a.holds_lease()

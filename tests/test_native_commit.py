@@ -134,8 +134,10 @@ def test_bind_assumed_bulk_native_matches_fallback():
     assert n_store["b-4"] == ""  # empty-target slot untouched
     assert n_store["b-5"] == "node-5"  # idempotent re-bind
 
-    # same event stream shape: MODIFIED for each success, rv ascending
-    assert len(n_events) == len(f_events) == 2
+    # same event stream shape: MODIFIED for each success, rv ascending;
+    # the slot-5 same-node re-bind is idempotent success WITHOUT a
+    # duplicate event (no write, no rv bump) -- only slot 0 emits
+    assert len(n_events) == len(f_events) == 1
     assert all(ev.type == "MODIFIED" for ev in n_events)
     rvs = [ev.resource_version for ev in n_events]
     assert rvs == sorted(rvs)

@@ -1,0 +1,419 @@
+"""Control-plane resilience units (PR 2): the 410 Gone watch-truncation
+contract, the assumed-pod TTL sweeper (formerly dead cache path), the
+cache<->apiserver drift checker, idempotent same-node re-binds, and
+startup crash recovery."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import Binding
+from kubernetes_tpu.apiserver.server import APIServer, Gone
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.robustness.faults import (
+    FaultInjector,
+    FaultPoint,
+    FaultProfile,
+    PointConfig,
+    install_injector,
+)
+from kubernetes_tpu.scheduler.resilience import (
+    ControlPlaneReconciler,
+    recover_on_startup,
+)
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+from kubernetes_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    yield
+    install_injector(None)
+
+
+# ---------------------------------------------------------------------------
+# 410 Gone: truncated watch replay must signal, not silently skip
+# ---------------------------------------------------------------------------
+
+
+class TestWatchGone:
+    def test_truncated_replay_raises_gone(self):
+        server = APIServer(watch_history_limit=8)
+        for i in range(30):  # several trims
+            server.create(make_pod(f"p{i}").obj())
+        with pytest.raises(Gone):
+            server.watch("Pod", since_rv=1)
+
+    def test_replay_within_window_still_works(self):
+        server = APIServer(watch_history_limit=8)
+        for i in range(30):
+            server.create(make_pod(f"p{i}").obj())
+        rv = server.current_rv()
+        server.create(make_pod("tail").obj())
+        w = server.watch("Pod", since_rv=rv)
+        evs = w.pending()
+        assert [e.object.metadata.name for e in evs] == ["tail"]
+
+    def test_untruncated_history_never_gone(self):
+        server = APIServer()
+        for i in range(10):
+            server.create(make_pod(f"p{i}").obj())
+        w = server.watch("Pod", since_rv=0)
+        assert len(w.pending()) == 10
+
+    def test_injected_gone_fires(self):
+        server = APIServer()
+        install_injector(FaultInjector(FaultProfile(
+            "trunc", seed=0,
+            points={
+                FaultPoint.WATCH_HISTORY_TRUNCATED: PointConfig(
+                    rate=1.0, max_fires=1
+                )
+            },
+        )))
+        with pytest.raises(Gone):
+            server.watch("Pod", since_rv=server.current_rv())
+        # the point healed: the next open succeeds
+        server.watch("Pod", since_rv=server.current_rv())
+
+    def test_informer_relists_through_injected_gone(self):
+        """An informer whose relist hits 410 Gone (injected) must list
+        again and converge -- no event silently lost, watch_gone
+        metered."""
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        pods_inf = informers.pods()
+        pods_inf.pump()  # initial sync
+        before_gone = metrics.watch_gone.value(kind="Pod")
+        client.create_pod(make_pod("a").container(cpu="1m").obj())
+        # force a relist (watch_drop) whose first watch open gets 410
+        install_injector(FaultInjector(FaultProfile(
+            "drop+gone", seed=0,
+            points={
+                FaultPoint.WATCH_DROP: PointConfig(rate=1.0, max_fires=1),
+                FaultPoint.WATCH_HISTORY_TRUNCATED: PointConfig(
+                    rate=1.0, max_fires=1
+                ),
+            },
+        )))
+        pods_inf.pump()  # drop -> relist -> Gone -> list again
+        install_injector(None)
+        client.create_pod(make_pod("b").container(cpu="1m").obj())
+        pods_inf.pump()
+        assert {p.metadata.name for p in pods_inf.list()} == {"a", "b"}
+        assert metrics.watch_gone.value(kind="Pod") > before_gone
+        assert pods_inf.synced
+
+
+# ---------------------------------------------------------------------------
+# idempotent same-node re-bind (crash-recovery contract)
+# ---------------------------------------------------------------------------
+
+
+class TestIdempotentRebind:
+    def test_same_node_rebind_is_silent_success(self):
+        server = APIServer()
+        pod = make_pod("p").container(cpu="1m").obj()
+        server.create(pod)
+        binding = Binding(
+            pod_namespace="default", pod_name="p",
+            pod_uid=pod.metadata.uid, target_node="n1",
+        )
+        bound = server.bind(binding)
+        rv = bound.metadata.resource_version
+        w = server.watch("Pod", since_rv=server.current_rv())
+        again = server.bind(binding)  # retried commit that already landed
+        assert again.spec.node_name == "n1"
+        assert again.metadata.resource_version == rv  # no write
+        assert w.pending() == []  # no duplicate event
+
+    def test_other_node_rebind_still_conflicts(self):
+        from kubernetes_tpu.apiserver.server import Conflict
+
+        server = APIServer()
+        pod = make_pod("p").container(cpu="1m").obj()
+        server.create(pod)
+        server.bind(Binding(
+            pod_namespace="default", pod_name="p",
+            pod_uid=pod.metadata.uid, target_node="n1",
+        ))
+        with pytest.raises(Conflict):
+            server.bind(Binding(
+                pod_namespace="default", pod_name="p",
+                pod_uid=pod.metadata.uid, target_node="n2",
+            ))
+
+    def test_bind_assumed_bulk_same_node_is_success(self):
+        server = APIServer()
+        pod = make_pod("p").container(cpu="1m").obj()
+        server.create(pod)
+        assumed = pod.assumed_clone()
+        assumed.spec.node_name = "n1"
+        assert server.bind_assumed_bulk([assumed]) == []
+        # the whole "transaction replayed after a crash" shape
+        assert server.bind_assumed_bulk([assumed]) == []
+
+
+# ---------------------------------------------------------------------------
+# the sweeper: assumed-pod TTL expiry wired in (formerly dead code)
+# ---------------------------------------------------------------------------
+
+
+def _mk_sched(num_nodes=4, ttl=0.05):
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(
+        client, informers, batch=False, cache_ttl_seconds=ttl,
+    )
+    for i in range(num_nodes):
+        client.create_node(
+            make_node(f"n{i}").capacity(cpu="8", memory="16Gi", pods=30).obj()
+        )
+    return server, client, informers, sched
+
+
+class TestAssumedPodSweep:
+    def test_expired_assumed_pod_forgotten_and_requeued(self):
+        """A pod assumed + finish_binding'd whose confirmation never
+        arrives (still pending at the apiserver) expires after the TTL:
+        forgotten from the cache, requeued, metered."""
+        server, client, informers, sched = _mk_sched(ttl=0.05)
+        informers.pump()
+        pod = make_pod("stuck").container(cpu="100m").obj()
+        client.create_pod(pod)
+        assumed = pod.assumed_clone()
+        assumed.spec.node_name = "n0"
+        sched.cache.assume_pod(assumed)
+        sched.cache.finish_binding(assumed)
+        before = metrics.assumed_pods_expired.value()
+        rec = ControlPlaneReconciler(sched, client, sweep_interval=0.01)
+        time.sleep(0.08)  # past the TTL
+        expired = rec.sweep_assumed_once()
+        assert [p.metadata.name for p in expired] == ["stuck"]
+        assert metrics.assumed_pods_expired.value() == before + 1
+        assert sched.cache.get_pod(assumed) is None
+        # requeued: the pod is poppable again
+        pi = sched.queue.pop(timeout=1.0)
+        assert pi is not None and pi.pod.metadata.name == "stuck"
+
+    def test_expired_but_actually_bound_pod_readopted(self):
+        """The bind landed but its watch confirmation was lost: expiry
+        must re-adopt from apiserver truth, not requeue a running pod."""
+        server, client, informers, sched = _mk_sched(ttl=0.05)
+        informers.pump()
+        pod = make_pod("landed").container(cpu="100m").obj()
+        client.create_pod(pod)
+        assumed = pod.assumed_clone()
+        assumed.spec.node_name = "n0"
+        sched.cache.assume_pod(assumed)
+        sched.cache.finish_binding(assumed)
+        server.bind_assumed_bulk([assumed])  # the bind actually landed
+        rec = ControlPlaneReconciler(sched, client, sweep_interval=0.01)
+        time.sleep(0.08)
+        rec.sweep_assumed_once()
+        cached = sched.cache.get_pod(assumed)
+        assert cached is not None and cached.spec.node_name == "n0"
+        assert not sched.cache.is_assumed_pod(assumed)  # confirmed now
+        assert sched.queue.pop(timeout=0.1) is None  # NOT requeued
+
+    def test_unexpired_assumed_pod_untouched(self):
+        server, client, informers, sched = _mk_sched(ttl=30.0)
+        informers.pump()
+        pod = make_pod("inflight").container(cpu="100m").obj()
+        client.create_pod(pod)
+        assumed = pod.assumed_clone()
+        assumed.spec.node_name = "n0"
+        sched.cache.assume_pod(assumed)
+        sched.cache.finish_binding(assumed)
+        rec = ControlPlaneReconciler(sched, client, sweep_interval=0.01)
+        assert rec.sweep_assumed_once() == []
+        assert sched.cache.is_assumed_pod(assumed)
+
+
+# ---------------------------------------------------------------------------
+# drift checker
+# ---------------------------------------------------------------------------
+
+
+class TestDriftChecker:
+    def test_heals_pod_missing_from_cache(self):
+        server, client, informers, sched = _mk_sched()
+        informers.pump()
+        pod = make_pod("ghost").container(cpu="100m").obj()
+        client.create_pod(pod)
+        bound = server.bind(Binding(
+            pod_namespace="default", pod_name="ghost",
+            pod_uid=pod.metadata.uid, target_node="n1",
+        ))
+        # cache never hears about it (no pump): divergence
+        before = metrics.cache_drift.value(kind="pod", action="readopt")
+        rec = ControlPlaneReconciler(sched, client)
+        report = rec.check_drift_once()
+        assert report.pods_readopted == 1
+        assert metrics.cache_drift.value(
+            kind="pod", action="readopt"
+        ) == before + 1
+        assert sched.cache.get_pod(bound) is not None
+        # converged: the next check finds nothing
+        assert rec.check_drift_once().total() == 0
+
+    def test_heals_phantom_pod_in_cache(self):
+        """A pod the cache believes is placed but the apiserver shows
+        pending (cache corruption): evicted from the cache AND given
+        back to the queue."""
+        server, client, informers, sched = _mk_sched()
+        informers.pump()
+        pod = make_pod("phantom").container(cpu="100m").obj()
+        client.create_pod(pod)  # pending at the apiserver
+        placed = pod.assumed_clone()
+        placed.spec.node_name = "n2"
+        sched.cache.add_pod(placed)  # cache wrongly holds it as placed
+        rec = ControlPlaneReconciler(sched, client)
+        report = rec.check_drift_once()
+        assert report.pods_evicted == 1 and report.pods_requeued == 1
+        assert sched.cache.get_pod(placed) is None
+        pi = sched.queue.pop(timeout=1.0)
+        assert pi is not None and pi.pod.metadata.name == "phantom"
+
+    def test_heals_deleted_pod_still_in_cache(self):
+        server, client, informers, sched = _mk_sched()
+        informers.pump()
+        pod = make_pod("gone").container(cpu="100m").obj()
+        placed = pod.assumed_clone()
+        placed.spec.node_name = "n0"
+        sched.cache.add_pod(placed)  # never existed at the apiserver
+        rec = ControlPlaneReconciler(sched, client)
+        report = rec.check_drift_once()
+        assert report.pods_evicted == 1 and report.pods_requeued == 0
+        assert sched.cache.get_pod(placed) is None
+
+    def test_assumed_pods_never_healed(self):
+        """The assumed overlay is the scheduler's own in-flight state --
+        the drift checker must leave it alone."""
+        server, client, informers, sched = _mk_sched()
+        informers.pump()
+        pod = make_pod("inflight").container(cpu="100m").obj()
+        client.create_pod(pod)
+        assumed = pod.assumed_clone()
+        assumed.spec.node_name = "n0"
+        sched.cache.assume_pod(assumed)
+        rec = ControlPlaneReconciler(sched, client)
+        report = rec.check_drift_once()
+        assert report.pods_evicted == 0
+        assert sched.cache.is_assumed_pod(assumed)
+
+    def test_heals_node_drift_both_directions(self):
+        server, client, informers, sched = _mk_sched(num_nodes=3)
+        informers.pump()
+        # cache misses a node and holds a deleted one
+        from kubernetes_tpu.api.types import Node, ObjectMeta
+
+        sched.cache.remove_node(
+            Node(metadata=ObjectMeta(name="n0", namespace=""))
+        )
+        client.delete_node("n2")
+        # no pump: the cache still holds n2, is missing n0
+        rec = ControlPlaneReconciler(sched, client)
+        report = rec.check_drift_once()
+        assert report.nodes_added == 1 and report.nodes_removed == 1
+        assert set(sched.cache.known_node_names()) == {"n0", "n1"}
+
+    def test_sweeper_thread_heals_within_interval(self):
+        """The acceptance shape: an injected divergence heals within one
+        sweep interval of the running reconciler thread."""
+        server, client, informers, sched = _mk_sched()
+        informers.pump()
+        pod = make_pod("ghost").container(cpu="100m").obj()
+        client.create_pod(pod)
+        server.bind(Binding(
+            pod_namespace="default", pod_name="ghost",
+            pod_uid=pod.metadata.uid, target_node="n1",
+        ))
+        before = metrics.cache_drift.value(kind="pod", action="readopt")
+        rec = ControlPlaneReconciler(
+            sched, client, sweep_interval=0.02, drift_interval=0.05
+        )
+        rec.start()
+        try:
+            deadline = time.time() + 2.0
+            while (
+                sched.cache.get_pod(pod) is None and time.time() < deadline
+            ):
+                time.sleep(0.01)
+            assert sched.cache.get_pod(pod) is not None
+            assert metrics.cache_drift.value(
+                kind="pod", action="readopt"
+            ) == before + 1
+        finally:
+            rec.stop()
+
+
+# ---------------------------------------------------------------------------
+# startup crash recovery
+# ---------------------------------------------------------------------------
+
+
+class TestStartupRecovery:
+    def test_adopts_bound_and_requeues_pending(self):
+        server = APIServer()
+        client = Client(server)
+        # a previous incarnation bound 3 pods and left 2 in flight
+        for i in range(3):
+            p = make_pod(f"bound-{i}").container(cpu="100m").obj()
+            client.create_pod(p)
+            server.bind(Binding(
+                pod_namespace="default", pod_name=p.metadata.name,
+                pod_uid=p.metadata.uid, target_node=f"n{i}",
+            ))
+        for i in range(2):
+            client.create_pod(
+                make_pod(f"inflight-{i}").container(cpu="100m").obj()
+            )
+        for i in range(3):
+            client.create_node(
+                make_node(f"n{i}").capacity(cpu="8", memory="16Gi").obj()
+            )
+        informers = InformerFactory(server)
+        sched = new_scheduler(client, informers, batch=False)
+        informers.pump()
+        a0 = metrics.pods_adopted_on_restart.value()
+        r0 = metrics.pods_requeued_on_restart.value()
+        report = recover_on_startup(sched, client)
+        assert report.adopted == 3
+        assert report.requeued == 2
+        assert report.healed == 0  # the informer sync already adopted
+        assert metrics.pods_adopted_on_restart.value() == a0 + 3
+        assert metrics.pods_requeued_on_restart.value() == r0 + 2
+        assert sched.cache.pod_count() == 3
+
+    def test_heals_bound_pod_missed_by_sync(self):
+        server = APIServer()
+        client = Client(server)
+        p = make_pod("missed").container(cpu="100m").obj()
+        client.create_pod(p)
+        server.bind(Binding(
+            pod_namespace="default", pod_name="missed",
+            pod_uid=p.metadata.uid, target_node="n0",
+        ))
+        informers = InformerFactory(server)
+        sched = new_scheduler(client, informers, batch=False)
+        # NO informer pump: simulate the sync miss
+        report = recover_on_startup(sched, client)
+        assert report.adopted == 1 and report.healed == 1
+        assert sched.cache.pod_count() == 1
+
+    def test_foreign_scheduler_pods_not_requeued(self):
+        server = APIServer()
+        client = Client(server)
+        p = make_pod("other").container(cpu="100m").obj()
+        p.spec.scheduler_name = "someone-elses-scheduler"
+        client.create_pod(p)
+        informers = InformerFactory(server)
+        sched = new_scheduler(client, informers, batch=False)
+        informers.pump()
+        report = recover_on_startup(sched, client)
+        assert report.requeued == 0
