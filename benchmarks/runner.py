@@ -226,6 +226,31 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
             )
         client.create_node(nw.obj())
 
+    # per-node CSINode objects (nodevolumelimits/csi.go attach limits):
+    # the volume-count device columns read allocatable from these, so a
+    # CSI workload exercises the limit columns end to end. Absent
+    # CSINodes mean "no limit known" (the reference allows).
+    csn = wl.get("csi_node") or node_spec.get("csi_node")
+    if csn:
+        from kubernetes_tpu.api.types import CSINode, CSINodeDriver
+        from kubernetes_tpu.api.types import ObjectMeta as _OM
+
+        for i in range(num_nodes):
+            server.create(
+                CSINode(
+                    metadata=_OM(name=f"node-{i}", namespace=""),
+                    drivers=[
+                        CSINodeDriver(
+                            name=csn.get("driver", "ebs.csi.aws.com"),
+                            node_id=f"node-{i}",
+                            allocatable_count=int(
+                                csn.get("allocatable", 8)
+                            ),
+                        )
+                    ],
+                )
+            )
+
     for svc in wl.get("services") or []:
         server.create(
             Service(
@@ -511,6 +536,11 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
             "batches": sched.batches_solved,
             "pods_on_device": sched.pods_solved_on_device,
             "pods_fallback": sched.pods_fallback,
+            "classified": getattr(sched, "admissions_classified", 0),
+            "reclassified": getattr(sched, "reclassifications", 0),
+            "volume_reject_retries": getattr(
+                sched, "volume_reject_retries", 0
+            ),
             "envelope_fallbacks": sched.envelope_fallbacks,
             "pipeline_drains": sched.pipeline_drains,
             "state_reuses": sched.state_reuses,

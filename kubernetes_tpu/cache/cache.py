@@ -46,6 +46,9 @@ class SchedulerCache:
         self._nodes: Dict[str, NodeInfo] = {}
         self._pod_states: Dict[str, _PodState] = {}  # key: pod uid
         self._assumed_pods: Dict[str, bool] = {}
+        # CSINode objects stashed by node name: a CSINode can arrive
+        # before its Node (separate informers), so add_node re-applies it
+        self._csi_nodes: Dict[str, object] = {}
 
     # -- assume / bind lifecycle (cache.go:344-) ----------------------------
 
@@ -137,6 +140,15 @@ class SchedulerCache:
             if state.pod.spec.node_name != pod.spec.node_name:
                 self._remove_pod_from_node(state.pod)
                 self._add_pod_to_node(pod)
+            else:
+                # same-node confirm keeps the clone's node accounting:
+                # the eventual remove must subtract exactly what the
+                # clone's volume-count memo added, so the memo carries
+                # forward onto the confirming object (re-resolving it
+                # against the live listers could differ)
+                memo = state.pod.__dict__.get("_volcount_memo")
+                if memo is not None:
+                    pod.__dict__["_volcount_memo"] = memo
             self._pod_states[key] = _PodState(pod=pod, assumed=False)
             self._assumed_pods.pop(key, None)
             return
@@ -207,9 +219,13 @@ class SchedulerCache:
         with self._lock:
             ni = self._nodes.get(node.metadata.name)
             if ni is None:
-                self._nodes[node.metadata.name] = NodeInfo(node)
+                ni = NodeInfo(node)
+                self._nodes[node.metadata.name] = ni
             else:
                 ni.set_node(node)
+            csi = self._csi_nodes.get(node.metadata.name)
+            if csi is not None and not ni.csi_volume_limits:
+                ni.set_csi_node(csi)
 
     def update_node(self, old: Node, new: Node) -> None:
         self.add_node(new)
@@ -224,6 +240,28 @@ class SchedulerCache:
                 ni.node = None
                 ni.generation = next_generation()
                 self._nodes[node.metadata.name] = ni
+
+    # -- CSINode events (attachable-volume limits) --------------------------
+
+    def add_csi_node(self, csi_node) -> None:
+        """Apply a CSINode's per-driver attach limits to its NodeInfo
+        (same object name as the node). Arriving before the Node is fine:
+        the object is stashed and applied by add_node."""
+        with self._lock:
+            self._csi_nodes[csi_node.metadata.name] = csi_node
+            ni = self._nodes.get(csi_node.metadata.name)
+            if ni is not None:
+                ni.set_csi_node(csi_node)
+
+    def update_csi_node(self, old, new) -> None:
+        self.add_csi_node(new)
+
+    def remove_csi_node(self, csi_node) -> None:
+        with self._lock:
+            self._csi_nodes.pop(csi_node.metadata.name, None)
+            ni = self._nodes.get(csi_node.metadata.name)
+            if ni is not None:
+                ni.set_csi_node(None)
 
     def node_count(self) -> int:
         with self._lock:

@@ -28,6 +28,41 @@ from kubernetes_tpu.api.types import (
 DEFAULT_MILLI_CPU_REQUEST = 100  # 0.1 core
 DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024  # 200 MiB
 
+# -- attachable-volume count resources --------------------------------------
+# Countable volume limits ride the node tensor as synthetic scalar columns
+# (the reference models in-tree limits the same way, as
+# ``attachable-volumes-*`` node resources; nodevolumelimits/non_csi.go).
+# CSI drivers get one column each (``attachable-volumes-csi-<driver>``,
+# allocatable from CSINode); in-tree types use the reference's fixed
+# per-cloud defaults. A node with no known limit for a column advertises
+# VOLUME_UNLIMITED (csi.go:72: CSINode absent -> no limits known -> allow).
+CSI_ATTACH_PREFIX = "attachable-volumes-csi-"
+EBS_VOLUME_RESOURCE = "attachable-volumes-aws-ebs"
+GCE_PD_VOLUME_RESOURCE = "attachable-volumes-gce-pd"
+AZURE_DISK_VOLUME_RESOURCE = "attachable-volumes-azure-disk"
+INTREE_VOLUME_LIMITS = {
+    EBS_VOLUME_RESOURCE: 39,
+    GCE_PD_VOLUME_RESOURCE: 16,
+    AZURE_DISK_VOLUME_RESOURCE: 16,
+}
+VOLUME_UNLIMITED = 1 << 24  # "no limit known"; safely below int32 overflow
+
+
+def pod_volume_counts(pod: Pod) -> Tuple:
+    """Per-limit-resource attachable-volume counts for a pod, as a sorted
+    ``((resource_name, count), ...)`` tuple. The counts are RESOLVED
+    (PVC -> PV) by the scheduler's admission classifier / ingest hook
+    (scheduler/admission.py), which stores them in ``_volcount_memo`` on
+    the pod object; without that memo the counts are empty and volume
+    columns stay zero (the standalone-cache behavior before this PR).
+
+    The memo must be stable between ``add_pod`` and ``remove_pod`` for a
+    cached pod object (the in-use accounting subtracts what it added);
+    classification only rewrites the memo on pods that are not yet in
+    the cache, and assumed clones freeze their own copy of it."""
+    return pod.__dict__.get("_volcount_memo") or ()
+
+
 _generation = itertools.count(1)
 
 
@@ -186,6 +221,11 @@ class NodeInfo:
         self.non_zero_requested = Resource()
         self.allocatable = Resource()
         self.image_states: Dict[str, int] = {}  # image name -> size bytes
+        # attachable-volume bookkeeping for the device columns:
+        # per-resource limits from this node's CSINode (empty -> defaults/
+        # unlimited) and the additive in-use counts from resident pods
+        self.csi_volume_limits: Dict[str, int] = {}
+        self.volume_in_use: Dict[str, int] = {}
         self.generation: int = next_generation()
         if node is not None:
             self.set_node(node)
@@ -199,6 +239,28 @@ class NodeInfo:
             name: img.size_bytes for img in node.status.images for name in img.names
         }
         self.generation = next_generation()
+
+    def set_csi_node(self, csi_node) -> None:
+        """Apply (or clear, with None) this node's CSINode attach limits
+        (nodevolumelimits/csi.go:72 reads CSINode allocatable per
+        driver)."""
+        if csi_node is None:
+            self.csi_volume_limits = {}
+        else:
+            self.csi_volume_limits = {
+                CSI_ATTACH_PREFIX + d.name: d.allocatable_count
+                for d in csi_node.drivers
+                if d.allocatable_count is not None
+            }
+        self.generation = next_generation()
+
+    def volume_limit(self, resource: str) -> int:
+        """Allocatable for one volume-count column: CSINode-declared
+        limit, else the in-tree per-cloud default, else unlimited."""
+        lim = self.csi_volume_limits.get(resource)
+        if lim is not None:
+            return lim
+        return INTREE_VOLUME_LIMITS.get(resource, VOLUME_UNLIMITED)
 
     @property
     def node_name(self) -> str:
@@ -225,6 +287,11 @@ class NodeInfo:
             self.pods_with_affinity.append(pod)
         for ip, proto, port in ports:
             self.used_ports.add(ip, proto, port)
+        vc = pod.__dict__.get("_volcount_memo")
+        if vc:
+            viu = self.volume_in_use
+            for name, qty in vc:
+                viu[name] = viu.get(name, 0) + qty
         self.generation = next_generation()
 
     def remove_pod(self, pod: Pod) -> bool:
@@ -252,6 +319,11 @@ class NodeInfo:
         self.non_zero_requested.memory -= mem
         for ip, proto, port in ports:
             self.used_ports.remove(ip, proto, port)
+        vc = pod.__dict__.get("_volcount_memo")
+        if vc:
+            viu = self.volume_in_use
+            for name, qty in vc:
+                viu[name] = viu.get(name, 0) - qty
         self.generation = next_generation()
         return True
 
@@ -267,6 +339,8 @@ class NodeInfo:
         ni.non_zero_requested = self.non_zero_requested.clone()
         ni.allocatable = self.allocatable.clone()
         ni.image_states = dict(self.image_states)
+        ni.csi_volume_limits = dict(self.csi_volume_limits)
+        ni.volume_in_use = dict(self.volume_in_use)
         ni.generation = self.generation
         return ni
 

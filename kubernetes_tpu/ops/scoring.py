@@ -148,6 +148,16 @@ def batch_score_dynamic(
         _preferred_aff_terms(p) or _preferred_anti_terms(p) for p in pods
     ):
         return True
+    return batch_selector_spread_live(pods, informers)
+
+
+def batch_selector_spread_live(pods: List[Pod], informers) -> bool:
+    """The informer-dependent slice of ``batch_score_dynamic``: selector
+    spread is live for the batch when workload objects exist AND a pod
+    without its own spread constraints matches one. Split out so the
+    dispatcher can answer the spec-derived parts from the cached
+    admission bits (scheduler/admission.py) and only pay this check
+    against live cluster state."""
     if informers is None:
         return False
     if not any(

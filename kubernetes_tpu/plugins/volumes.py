@@ -34,7 +34,13 @@ from kubernetes_tpu.api.types import (
     VOLUME_BINDING_WAIT,
     Volume,
 )
-from kubernetes_tpu.cache.node_info import NodeInfo
+from kubernetes_tpu.cache.node_info import (
+    AZURE_DISK_VOLUME_RESOURCE,
+    CSI_ATTACH_PREFIX,
+    EBS_VOLUME_RESOURCE,
+    GCE_PD_VOLUME_RESOURCE,
+    NodeInfo,
+)
 from kubernetes_tpu.framework.interface import CycleState, Plugin, Status
 
 ERR_REASON_DISK_CONFLICT = "node(s) had no available disk"
@@ -120,47 +126,93 @@ class _Listers:
         return self.informers.persistent_volumes().list()
 
 
-def volumes_device_safe(pod, listers: _Listers) -> bool:
-    """True when EVERY volume filter is provably node-independent for
-    this pod, so the batch solver can treat it as a plain pod (VERDICT
-    r4 missing #3: PVC-bound pods used to take the host path
-    unconditionally):
+def classify_pod_volumes(pod, listers: _Listers) -> Tuple[str, Tuple]:
+    """Classify a pod's volumes for the device path. Returns
+    ``(host_reason, counts)``:
 
-    - no direct countable/conflict-bearing sources (GCE-PD, EBS, ISCSI,
-      RBD -- VolumeRestrictions + in-tree limits examine them), and
-    - every PVC is BOUND (claim.volume_name set) to an existing PV with
-      no node affinity, no zone labels (VolumeZone), and no countable
-      source (CSI/EBS/GCE/Azure limits resolve claims).
+    - ``host_reason == ""``: every volume filter is either provably
+      node-independent OR a pure attachable-volume COUNT the ``[N, R]``
+      tensor's volume columns enforce on device (tensors/node_tensor.py)
+      -- the pod rides the batch solver. Previously any countable source
+      fell off the device entirely (the 54 pods/s SchedulingCSIPVs
+      cliff).
+    - a non-empty reason keeps the pod on the exact host oracle: direct
+      in-tree sources (VolumeRestrictions mount-CONFLICT rules are
+      pairwise identity, not counts), unbound claims
+      (WaitForFirstConsumer / missing), missing PVs, PV node affinity,
+      or zonal PV labels (VolumeZone).
 
-    Everything else -- unbound claims (WaitForFirstConsumer), zonal or
-    countable PVs -- keeps the exact host path."""
+    ``counts`` is the sorted ``((limit_resource, n_unique_handles), ...)``
+    tuple over the pod's countable volumes -- resolved through PVC -> PV
+    for bound claims and read directly off in-tree sources -- and is
+    returned even for host-routed pods: the node's in-use accounting
+    (NodeInfo.volume_in_use) must see every attach regardless of which
+    path placed the pod. Counting is per-pod-unique and additive across
+    pods, i.e. conservative versus the oracle's per-node-unique handle
+    sets: the device can under-admit a shared handle but never
+    over-admit (the dispatcher re-checks device rejects of countable
+    pods on the host path)."""
+    reason = ""
+    handles: Dict[str, set] = {}
+
+    def count(resource: str, handle: str) -> None:
+        handles.setdefault(resource, set()).add(handle)
+
     for v in pod.spec.volumes:
         if (
             v.gce_pd_name or v.aws_ebs_volume_id
             or v.iscsi_target or v.rbd_image
         ):
-            return False
+            # conflict semantics, not counts: host path. The attach
+            # still consumes the node's in-tree limit budget.
+            reason = reason or "direct-volume-source"
+            if v.gce_pd_name:
+                count(GCE_PD_VOLUME_RESOURCE, v.gce_pd_name)
+            if v.aws_ebs_volume_id:
+                count(EBS_VOLUME_RESOURCE, v.aws_ebs_volume_id)
+            continue
         if not v.pvc_claim_name:
             continue
         pvc = listers.pvc(pod.metadata.namespace, v.pvc_claim_name)
         if pvc is None or not pvc.volume_name:
-            return False
+            reason = reason or "unbound-pvc"
+            continue
         pv = listers.pv(pvc.volume_name)
         if pv is None:
-            return False
+            reason = reason or "pv-missing"
+            continue
         if pv.node_affinity is not None:
-            return False
-        if any(
+            reason = reason or "pv-node-affinity"
+        elif any(
             k in pv.metadata.labels
             for k in LABEL_ZONE_KEYS + LABEL_REGION_KEYS
         ):
-            return False
-        if (
-            pv.csi_driver or pv.gce_pd_name or pv.aws_ebs_volume_id
-            or pv.azure_disk_name
-        ):
-            return False
-    return True
+            reason = reason or "pv-zonal"
+        if pv.csi_driver:
+            count(
+                CSI_ATTACH_PREFIX + pv.csi_driver,
+                pv.csi_volume_handle or pv.metadata.name,
+            )
+        elif pv.gce_pd_name:
+            count(GCE_PD_VOLUME_RESOURCE, pv.gce_pd_name)
+        elif pv.aws_ebs_volume_id:
+            count(EBS_VOLUME_RESOURCE, pv.aws_ebs_volume_id)
+        elif pv.azure_disk_name:
+            count(AZURE_DISK_VOLUME_RESOURCE, pv.azure_disk_name)
+    counts = tuple(
+        sorted((name, len(hs)) for name, hs in handles.items())
+    )
+    return reason, counts
+
+
+def volumes_device_safe(pod, listers: _Listers) -> bool:
+    """True when the batch solver can place this pod without the host
+    volume oracle (see ``classify_pod_volumes``). Since the
+    volume-count device columns landed, countable bound PVs (CSI and
+    in-tree via PVC) are device-safe too -- their limits solve as
+    ``[N, R]`` columns; only conflict-bearing direct sources, unbound
+    claims, and node-affine/zonal PVs keep the host path."""
+    return not classify_pod_volumes(pod, listers)[0]
 
 
 def _zone_values(value: str) -> set:

@@ -272,7 +272,13 @@ def host_greedy_assign(
     (ops/assignment._greedy_assign_impl): same fit semantics, same
     scores, same lowest-index argmax tie-break. Used when both device
     tiers are down -- no serving-link traffic at all. Returns
-    (assignments [B] int32, requested' [N, R], nzr' [N, 2])."""
+    (assignments [B] int32, requested' [N, R], nzr' [N, 2]).
+
+    The attachable-volume count columns (tensors/node_tensor.py) replay
+    here for free: they are ordinary scalar dims of the ``[N, R]``
+    layout, enforced by the same zero-request-skip fit rule as any
+    extended resource, so a countable-volume batch degrades through this
+    tier with identical placements."""
     from kubernetes_tpu.ops.assignment import NO_NODE, GreedyConfig
 
     if config is None:

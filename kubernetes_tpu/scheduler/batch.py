@@ -37,7 +37,11 @@ import jax.numpy as jnp
 
 from kubernetes_tpu.api.types import Binding, POD_GROUP_LABEL, Pod
 from kubernetes_tpu.cache.node_info import pod_host_ports
-from kubernetes_tpu.plugins.numa import ALIGNED_ANNOTATION
+from kubernetes_tpu.scheduler.admission import (
+    Admission,
+    classify_pod as _classify_pod,
+    solver_unsupported_reason,
+)
 from kubernetes_tpu.framework.interface import (
     CycleState,
     FitError,
@@ -54,8 +58,6 @@ from kubernetes_tpu.ops.assignment import (
 )
 from kubernetes_tpu.ops.affinity import (
     add_host_port_rows,
-    batch_has_affinity,
-    batch_has_required_anti_affinity,
     cluster_has_required_anti_affinity,
     noop_affinity_tensors,
     pack_affinity_batch,
@@ -64,8 +66,7 @@ from kubernetes_tpu.ops.affinity import (
 from kubernetes_tpu.ops.host_masks import static_mask_compact
 from kubernetes_tpu.ops.scoring import (
     ScoreEnvelopeExceeded,
-    batch_has_scoring_terms,
-    batch_score_dynamic,
+    batch_selector_spread_live,
     cluster_has_affinity_scoring,
     noop_score_tensors,
     pack_score_batch,
@@ -120,49 +121,20 @@ MAX_INFLIGHT = 3  # solver batches in flight between dispatcher and committer
 
 def solver_supported(pod: Pod) -> bool:
     """Constraints the device solver models today. Anything else falls
-    back to the sequential path (still fully correct, just not batched)."""
-    spec = pod.spec
-    # single-NUMA-aligned extended resources keep the host path: the
-    # per-node best-fit group bookkeeping (plugins/numa.py) is stateful
-    # per placement in ways the batch replay does not model
-    if pod.metadata.annotations.get(ALIGNED_ANNOTATION):
-        return False
-    # hard spread solves on device via the group-count scan
-    # (ops/topology.py) -- including spread coupled with node
-    # selectors/affinity, whose per-pod pair-count eligibility scopes
-    # the group's node_value row (topology._eligibility_sig); soft
-    # spread rides the scoring tensors (ops/scoring.py). Soft spread
-    # with node scoping still can't share score groups, so it falls
-    # back below.
-    if any(
-        c.when_unsatisfiable != "DoNotSchedule"
-        for c in spec.topology_spread_constraints
-    ) and (
-        spec.node_selector
-        or (
-            spec.affinity is not None
-            and spec.affinity.node_affinity is not None
-        )
-    ):
-        return False
-    # REQUIRED pod (anti-)affinity solves on device via the count-tensor
-    # replay (ops/affinity.py); preferred terms ride the weighted
-    # count-tensor score family (ops/scoring.py ipa_*). Host ports solve
-    # on device: existing-pod conflicts via the static mask (NodePorts
-    # folded into host_masks.static_mask_compact), within-batch
-    # conflicts via synthetic anti rows (affinity.add_host_port_rows).
-    # volume feasibility: pods whose volume filters are provably
-    # node-independent (bound PVCs to simple PVs) ride the solver; the
-    # caller couples this with plugins.volumes.volumes_device_safe
-    # (which needs the PVC/PV listers) -- solver_supported itself only
-    # screens the DIRECT sources the restrictions/limits plugins read
-    for v in spec.volumes:
-        if (
-            v.gce_pd_name or v.aws_ebs_volume_id
-            or v.iscsi_target or v.rbd_image
-        ):
-            return False
-    return True
+    back to the sequential path (still fully correct, just not batched).
+
+    Hard spread solves on device via the group-count scan
+    (ops/topology.py), REQUIRED pod (anti-)affinity via the count-tensor
+    replay (ops/affinity.py), preferred terms ride the ipa_* score
+    family, host ports ride the static mask + synthetic anti rows, and
+    attachable-volume COUNT limits ride the ``[N, R]`` volume columns
+    (tensors/node_tensor.py) -- so the remaining host-only shapes are
+    NUMA-aligned pods, soft spread with node scoping, and direct
+    conflict-bearing volume sources. The per-shape reason strings (and
+    the lister-dependent volume half of the decision) live in
+    scheduler/admission.py, which computes the full classification once
+    at informer ingest."""
+    return not solver_unsupported_reason(pod)
 
 
 class _DeviceNodeState:
@@ -269,6 +241,24 @@ class BatchScheduler(Scheduler):
         self._deferred_since = 0.0
         self._prewarm_next_commit = False
         self._committer_stop = False
+        # -- admission classifier state (scheduler/admission.py) ---------
+        # volume-topology generation: bumped by every PV/PVC/StorageClass/
+        # CSINode event (eventhandlers), compared against each PVC-bearing
+        # pod's cached admission record at pop time
+        self._volume_topo_gen = 0
+        # memo ownership token: an admission record from another scheduler
+        # instance (different extenders / dims registry) is re-classified
+        self._admission_token = object()
+        self.admissions_classified = 0
+        self.reclassifications = 0
+        self.volume_reject_retries = 0  # device NO_NODE -> host re-checks
+        # per-stage wall-clock accumulators (bench.py --profile); the
+        # per-pod classify stage is only timed when profile_stages is on.
+        # Locked: the dispatcher (pop/classify/pack/device_solve) and the
+        # committer (download/commit) both accumulate
+        self.profile_stages = False
+        self.stage_seconds: dict = {}
+        self._stage_lock = threading.Lock()
         # collect-at-idle gc policy, engaged only by the production run
         # loop (tests driving schedule_batch directly keep gc untouched)
         self._gc_guard = None
@@ -300,9 +290,11 @@ class BatchScheduler(Scheduler):
         and committing the previous result, so the serving link's
         round-trip latency is overlapped with host commit work instead of
         serializing with it."""
+        t_pop = time.perf_counter()
         batch_infos = self.queue.pop_batch(
             self.max_batch, timeout=timeout, window=self.batch_window
         )
+        self._stage_add("pop_batch", time.perf_counter() - t_pop)
         guard = self._gc_guard
         if not batch_infos:
             # idle: finish whatever is still in flight
@@ -335,17 +327,23 @@ class BatchScheduler(Scheduler):
                 self.batches_solved += 1
                 solver_infos.clear()
 
-        extenders = self.algorithm.extenders
+        # admission is a precomputed-field read here: the classifier ran
+        # at informer ingest (eventhandlers), so the hot loop does one
+        # memo get per pod instead of re-walking annotations, volume
+        # sources, and NUMA hints per pod per cycle (the round-5
+        # regression). Stale volume classifications re-check inside
+        # _admission_of.
+        profiling = self.profile_stages
         for pi in batch_infos:
             if self._skip_pod_schedule(pi.pod):
                 continue
-            if (
-                solver_supported(pi.pod)
-                and self._volumes_device_safe(pi.pod)
-                and not any(
-                    e.is_interested(pi.pod) for e in extenders
-                )
-            ):
+            if profiling:
+                t_cls = time.perf_counter()
+                adm = self._admission_of(pi.pod)
+                self._stage_add("classify", time.perf_counter() - t_cls)
+            else:
+                adm = self._admission_of(pi.pod)
+            if adm.device_ok:
                 # one profile per solver batch: score weights and owner
                 # lookups are profile-scoped (the sequential path resolves
                 # them per pod, scheduler.go:741)
@@ -501,11 +499,10 @@ class BatchScheduler(Scheduler):
         with self._pending_cv:
             return any(p.get("has_required_anti") for p in self._pending_q)
 
-    def _volumes_device_safe(self, pod: Pod) -> bool:
-        """plugins.volumes.volumes_device_safe against the live
-        informer listers (lazily constructed)."""
-        if not any(v.pvc_claim_name for v in pod.spec.volumes):
-            return True
+    # -- admission classification (scheduler/admission.py) -------------------
+
+    def _listers(self):
+        """Lazily constructed shared PVC/PV/SC/CSINode lister access."""
         listers = self._volume_listers
         if listers is None:
             from kubernetes_tpu.plugins.volumes import _Listers
@@ -513,9 +510,93 @@ class BatchScheduler(Scheduler):
             prof = next(iter(self.profiles.values()), None)
             listers = _Listers(prof)
             self._volume_listers = listers
-        from kubernetes_tpu.plugins.volumes import volumes_device_safe
+        return listers
 
-        return volumes_device_safe(pod, listers)
+    def bump_volume_topology_gen(self) -> None:
+        """A PV/PVC/StorageClass/CSINode mutation landed: cached
+        admission records of PVC-bearing pods are stale from here."""
+        self._volume_topo_gen += 1
+
+    def classify_pod(self, pod: Pod) -> Admission:
+        """Compute + memoize the pod's admission record (called at
+        informer ingest by the event handlers, and lazily at pop time
+        for pods that entered the queue some other way). Does NOT touch
+        the tensor schema -- only the dispatcher thread registers volume
+        columns (_ensure_vol_columns), so the dims registry never grows
+        under a concurrently packing NodeTensorCache.update."""
+        self.admissions_classified += 1
+        return _classify_pod(
+            pod,
+            extenders=self.algorithm.extenders,
+            listers=self._listers(),
+            volume_gen=self._volume_topo_gen,
+            token=self._admission_token,
+        )
+
+    def attach_volume_counts(self, pod: Pod) -> None:
+        """Resolve + memoize a BOUND pod's attachable-volume counts
+        before it enters the cache (event handlers call this on the
+        cache side of the frame): NodeInfo.add_pod reads the memo into
+        the node's in-use accounting. Column registration for in-use
+        names happens on the dispatcher thread inside
+        NodeTensorCache.update (it scans NodeInfo.volume_in_use)."""
+        if not pod.spec.volumes or "_volcount_memo" in pod.__dict__:
+            return
+        from kubernetes_tpu.plugins.volumes import classify_pod_volumes
+
+        try:
+            _reason, counts = classify_pod_volumes(pod, self._listers())
+        except Exception:  # noqa: BLE001 - never block the cache path
+            logger.exception("volume counts for %s", pod.key())
+            counts = ()
+        pod.__dict__["_volcount_memo"] = counts
+
+    def _ensure_vol_columns(self, adm: Admission) -> None:
+        """Register the record's volume resources as tensor columns.
+        Dispatcher-thread only: schema growth must never race the
+        packer (registration bumps dims.version, so the next
+        NodeTensorCache.update full-repacks with the new column)."""
+        if adm.vol_counts:
+            dims = self.tensor_cache.dims
+            for name, _qty in adm.vol_counts:
+                dims.volume_column(name)
+
+    def _admission_of(self, pod: Pod) -> Admission:
+        """The pop-time admission read: a memo hit is a dict get; a miss
+        (new object, foreign token) or a stale volume classification
+        (PVC binding landed mid-queue) re-classifies. Dispatcher-thread
+        only (it registers volume columns)."""
+        adm = pod.__dict__.get("_admission")
+        if adm is not None and adm.token is self._admission_token:
+            if adm.pinned or not adm.has_pvc:
+                return adm
+            if adm.volume_gen == self._volume_topo_gen:
+                self._ensure_vol_columns(adm)
+                return adm
+            self.reclassifications += 1
+        adm = self.classify_pod(pod)
+        self._ensure_vol_columns(adm)
+        return adm
+
+    def _memo_admissions(self, solver_infos: List[PodInfo]) -> List[Admission]:
+        """Admission records for a dispatched batch, without the
+        staleness re-check: routing was decided at pop time, and the
+        record's feature bits describe the same pod object either way."""
+        out = []
+        token = self._admission_token
+        for pi in solver_infos:
+            adm = pi.pod.__dict__.get("_admission")
+            if adm is None or adm.token is not token:
+                adm = self.classify_pod(pi.pod)
+                self._ensure_vol_columns(adm)
+            out.append(adm)
+        return out
+
+    def _stage_add(self, name: str, seconds: float) -> None:
+        with self._stage_lock:
+            self.stage_seconds[name] = (
+                self.stage_seconds.get(name, 0.0) + seconds
+            )
 
     def _pending_has_ports(self) -> bool:
         with self._pending_cv:
@@ -664,16 +745,17 @@ class BatchScheduler(Scheduler):
         in-flight batch would change (spread counts, nominee overlays,
         incompatible clusters) drain the pipeline first."""
         timeline.mark(f"dispatch_start b={len(solver_infos)}")
+        t_pack = time.perf_counter()
         pods = [pi.pod for pi in solver_infos]
-        has_hard_spread = any(
-            c.when_unsatisfiable == "DoNotSchedule"
-            for p in pods
-            for c in p.spec.topology_spread_constraints
-        )
-        batch_ports = any(pod_host_ports(p) for p in pods)
-        has_affinity_terms = batch_has_affinity(pods)
+        # batch-level constraint aggregates from the cached admission
+        # feature bits (scheduler/admission.py): any() over memo reads
+        # instead of re-walking every spec per dispatch
+        adms = self._memo_admissions(solver_infos)
+        has_hard_spread = any(a.hard_spread for a in adms)
+        batch_ports = any(a.ports for a in adms)
+        has_affinity_terms = any(a.affinity_req for a in adms)
         has_affinity = has_affinity_terms or batch_ports
-        has_required_anti = batch_has_required_anti_affinity(pods)
+        has_required_anti = any(a.required_anti for a in adms)
         prof0 = self.profiles.get(pods[0].spec.scheduler_name)
         # gated on the profile actually scoring with InterPodAffinity --
         # otherwise the ipa family packs nothing and draining for it
@@ -683,16 +765,21 @@ class BatchScheduler(Scheduler):
             if prof0 is not None
             else 0
         )
-        score_dynamic = batch_score_dynamic(
-            pods,
-            prof0.informers if prof0 is not None else None,
-            ipa_weight=ipa_weight,
+        score_dynamic = (
+            any(a.score_soft for a in adms)
+            or (
+                bool(ipa_weight)
+                and any(a.score_pref for a in adms)
+            )
+            or batch_selector_spread_live(
+                pods, prof0.informers if prof0 is not None else None
+            )
         )
         # this batch's pods become symmetric scorers for later batches
         # once placed (preferred terms, and required affinity terms via
         # hardPodAffinityWeight)
-        has_scoring_terms = bool(ipa_weight) and batch_has_scoring_terms(
-            pods
+        has_scoring_terms = bool(ipa_weight) and any(
+            a.scoring_terms for a in adms
         )
         nominated_by_node = self.queue.all_nominated_pods_by_node()
 
@@ -966,6 +1053,7 @@ class BatchScheduler(Scheduler):
                         self.attempt_schedule(pi)
                     return None
 
+        self._stage_add("pack", time.perf_counter() - t_pack)
         solve_timer = metrics.SinceTimer(metrics.batch_solve_duration)
 
         # preemption prewarm: when the batch's most demanding request
@@ -1137,10 +1225,14 @@ class BatchScheduler(Scheduler):
             if not constrained and not self._pending_exists():
                 attempts.append((TIER_HOST_GREEDY, run_host_greedy))
             try:
+                t_solve = time.perf_counter()
                 with timeline.span("solve_dispatch"):
                     tier, out = self.ladder.run(
                         attempts, label=f"batch b={b}"
                     )
+                self._stage_add(
+                    "device_solve", time.perf_counter() - t_solve
+                )
             except LadderExhausted:
                 with self._shadow_lock:
                     ds.invalidate_carry()
@@ -1256,9 +1348,11 @@ class BatchScheduler(Scheduler):
             inj = get_injector()
             if inj is not None:
                 inj.raise_maybe(FaultPoint.DEVICE_SOLVE)
+            t_solve = time.perf_counter()
             assignments_dev, req_out, nzr_out = self._mesh_solve(
                 common_args, spread, affinity, score_batch, padded, nt
             )
+            self._stage_add("device_solve", time.perf_counter() - t_solve)
         except Exception:
             # mesh path: no pallas/host tier distinction -- a failed
             # sharded solve steps straight down to the sequential oracle
@@ -1374,10 +1468,12 @@ class BatchScheduler(Scheduler):
             return np.asarray(p["assignments_dev"])
 
         try:
+            t_dl = time.perf_counter()
             with timeline.span("download"):
                 assignments = self.ladder.watchdog.call(
                     download, timeout, tier=tier
                 )
+            self._stage_add("download", time.perf_counter() - t_dl)
         except SolveTimeout:
             if breaker is not None:
                 breaker.force_open()
@@ -1425,6 +1521,7 @@ class BatchScheduler(Scheduler):
                 np.add.at(req_s, rows_placed, p["req"][:b][placed])
                 np.add.at(nzr_s, rows_placed, p["nzr"][:b][placed])
                 ds.shadow_gens.append((req_s, nzr_s))
+        t_commit = time.perf_counter()
         with timeline.span("commit_batch"):
             self._commit_batch(
                 p["solver_infos"], p["order"], assignments, p["names"],
@@ -1432,6 +1529,7 @@ class BatchScheduler(Scheduler):
                 mask_info=(p.get("mask_rows"), p.get("mask_index_solved")),
                 gang_failed_uids=p.get("gang_failed_uids"),
             )
+        self._stage_add("commit", time.perf_counter() - t_commit)
         if (
             self._prewarm_next_commit
             and not self._deferred_preempt
@@ -1570,6 +1668,29 @@ class BatchScheduler(Scheduler):
         # identical unschedulable pods share one dict
         statuses_by_row: dict = {}
         for pi, choice, k in slow:
+            if choice == NO_NODE:
+                adm = pi.pod.__dict__.get("_admission")
+                if adm is not None and adm.vol_counts:
+                    # the additive volume-count columns are CONSERVATIVE
+                    # (a handle shared across resident pods counts once
+                    # per pod), so a device reject of a countable-volume
+                    # pod may be a false negative. Pin the pod host-only
+                    # and requeue straight to the activeQ: the next
+                    # cycle runs the exact per-node oracle (CSILimits /
+                    # in-tree unique-handle sets), which either places
+                    # it or produces the true unschedulable verdict.
+                    pi.pod.__dict__["_admission"] = adm.as_host_only(
+                        "volume-count-reject"
+                    )
+                    self.volume_reject_retries += 1
+                    self.record_scheduling_failure(
+                        prof, pi,
+                        "countable-volume pod rejected by the device "
+                        "solve; re-checking on the host path",
+                        "Unschedulable", "", pod_scheduling_cycle,
+                        skip_backoff=True,
+                    )
+                    continue
             state = CycleState()
             state.write(SNAPSHOT_STATE_KEY, snapshot)
             if choice == NO_NODE:

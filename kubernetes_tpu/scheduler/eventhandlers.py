@@ -35,8 +35,26 @@ def add_all_event_handlers(
     pods = informer_factory.pods()
     nodes = informer_factory.nodes()
 
+    # admission-classifier hooks (BatchScheduler only; the sequential
+    # scheduler has no device path to classify for): pending pods are
+    # classified ON INGEST so pop -> dispatch reads a precomputed field,
+    # bound pods get their attachable-volume counts resolved before the
+    # cache accounts them, and storage-object events bump the
+    # volume-topology generation that invalidates cached classifications
+    classify = getattr(sched, "classify_pod", None)
+    attach_counts = getattr(sched, "attach_volume_counts", None)
+    bump_volume_gen = getattr(sched, "bump_volume_topology_gen", None)
+
+    def _classify_safe(pod: Pod) -> None:
+        try:
+            classify(pod)
+        except Exception:
+            logger.exception("classifying pod %s", pod.key())
+
     # scheduled pods -> cache (eventhandlers.go:356)
     def add_pod_to_cache(pod: Pod) -> None:
+        if attach_counts is not None:
+            attach_counts(pod)
         try:
             sched.cache.add_pod(pod)
         except Exception:
@@ -48,6 +66,8 @@ def add_all_event_handlers(
         sched.queue.assigned_pod_added(pod)
 
     def update_pod_in_cache(old: Pod, new: Pod) -> None:
+        if attach_counts is not None:
+            attach_counts(new)
         try:
             sched.cache.update_pod(old, new)
         except KeyError:
@@ -65,6 +85,8 @@ def add_all_event_handlers(
 
     # unscheduled pods owned by one of our profiles -> queue (:381)
     def add_pod_to_queue(pod: Pod) -> None:
+        if classify is not None:
+            _classify_safe(pod)
         sched.queue.add(pod)
         # a new gang member can unblock siblings rejected by the
         # coscheduling fail-fast (total < minMember) -- wake exactly them
@@ -84,6 +106,10 @@ def add_all_event_handlers(
             )
 
     def update_pod_in_queue(old: Pod, new: Pod) -> None:
+        # the update arrives as a NEW object with no admission memo; an
+        # eager classification here keeps the pop loop a pure memo read
+        if classify is not None:
+            _classify_safe(new)
         sched.queue.update(old, new)
 
     def delete_pod_from_queue(pod: Pod) -> None:
@@ -221,6 +247,9 @@ def add_all_event_handlers(
         # cache phase (whole frame), then queue phase
         for kind, payload in cache_runs:
             if kind == "adds":
+                if attach_counts is not None:
+                    for pod in payload:
+                        attach_counts(pod)
                 try:
                     sched.cache.add_pods(payload)
                 except Exception:
@@ -240,6 +269,9 @@ def add_all_event_handlers(
                 update_pod_in_cache(*payload)
         for kind, payload in queue_runs:
             if kind == "adds":
+                if classify is not None:
+                    for pod in payload:
+                        _classify_safe(pod)
                 sched.queue.add_many(payload)
             elif kind == "dels":
                 sched.queue.delete_many(payload)
@@ -294,14 +326,34 @@ def add_all_event_handlers(
 
         return on_one
 
+    def _wake_volume(event):
+        """Storage-object mutations additionally invalidate cached
+        admission classifications: a PVC binding landing mid-queue must
+        re-classify the pod at pop time, not dispatch it under the
+        stale class."""
+        def on_one(*_args) -> None:
+            if bump_volume_gen is not None:
+                bump_volume_gen()
+            sched.queue.move_all_to_active_or_backoff_queue(event)
+
+        return on_one
+
     informer_factory.persistent_volumes().add_event_handler(
         ResourceEventHandler(
-            on_add=_wake(events.PvAdd), on_update=_wake(events.PvUpdate)
+            on_add=_wake_volume(events.PvAdd),
+            on_update=_wake_volume(events.PvUpdate),
+            # deletes can't make parked pods schedulable, but they MUST
+            # invalidate cached device-ok classifications: a pod whose
+            # PV vanished mid-queue has to re-classify to the host
+            # oracle instead of solving against the stale resolution
+            on_delete=_wake_volume(events.PvUpdate),
         )
     )
     informer_factory.persistent_volume_claims().add_event_handler(
         ResourceEventHandler(
-            on_add=_wake(events.PvcAdd), on_update=_wake(events.PvcUpdate)
+            on_add=_wake_volume(events.PvcAdd),
+            on_update=_wake_volume(events.PvcUpdate),
+            on_delete=_wake_volume(events.PvcUpdate),
         )
     )
     informer_factory.services().add_event_handler(
@@ -312,12 +364,41 @@ def add_all_event_handlers(
         )
     )
     informer_factory.storage_classes().add_event_handler(
-        ResourceEventHandler(on_add=_wake(events.StorageClassAdd))
+        ResourceEventHandler(on_add=_wake_volume(events.StorageClassAdd))
     )
+
+    # CSINode -> cache attach limits (nodevolumelimits/csi.go reads
+    # CSINode allocatable; the cache mirrors it onto NodeInfo so the
+    # tensor packer fills the volume-limit columns) + wakeups
+    def csi_node_upsert(event):
+        def on_one(*args) -> None:
+            obj = args[-1]
+            try:
+                sched.cache.add_csi_node(obj)
+            except Exception:
+                logger.exception("applying CSINode %s", obj.key())
+            if bump_volume_gen is not None:
+                bump_volume_gen()
+            sched.queue.move_all_to_active_or_backoff_queue(event)
+
+        return on_one
+
+    def csi_node_delete(obj) -> None:
+        try:
+            sched.cache.remove_csi_node(obj)
+        except Exception:
+            logger.exception("removing CSINode %s", obj.key())
+        if bump_volume_gen is not None:
+            bump_volume_gen()
+        sched.queue.move_all_to_active_or_backoff_queue(
+            events.CSINodeUpdate
+        )
+
     informer_factory.csi_nodes().add_event_handler(
         ResourceEventHandler(
-            on_add=_wake(events.CSINodeAdd),
-            on_update=_wake(events.CSINodeUpdate),
+            on_add=csi_node_upsert(events.CSINodeAdd),
+            on_update=csi_node_upsert(events.CSINodeUpdate),
+            on_delete=csi_node_delete,
         )
     )
 

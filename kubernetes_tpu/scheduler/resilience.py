@@ -51,6 +51,18 @@ class RecoveryReport:
     healed: int = 0  # bound pods the informer sync somehow missed
 
 
+def _attach_volume_counts(sched, pod) -> None:
+    """Every direct cache adoption must resolve the pod's attachable-
+    volume counts first (BatchScheduler.attach_volume_counts), or the
+    re-adopted pod's attaches go uncounted in NodeInfo.volume_in_use and
+    the device volume-limit columns over-admit past the node's CSINode
+    allocatable. The normal informer path does this in eventhandlers;
+    recovery/heal paths bypass it, so mirror it here."""
+    attach = getattr(sched, "attach_volume_counts", None)
+    if attach is not None:
+        attach(pod)
+
+
 def recover_on_startup(sched: "Scheduler", client: "Client") -> RecoveryReport:
     """Verify + meter the post-restart rebuild against apiserver ground
     truth. The informers' list+watch already rebuilt cache and queue; this
@@ -72,6 +84,7 @@ def recover_on_startup(sched: "Scheduler", client: "Client") -> RecoveryReport:
             if sched.cache.get_pod(pod) is None:
                 # informer sync missed it (watch raced the relist): adopt
                 try:
+                    _attach_volume_counts(sched, pod)
                     sched.cache.add_pod(pod)
                     report.healed += 1
                 except Exception:
@@ -175,6 +188,7 @@ class ControlPlaneReconciler:
                 continue
             try:
                 if live.spec.node_name:
+                    _attach_volume_counts(self.sched, live)
                     self.sched.cache.add_pod(live)
                     metrics.cache_drift.inc(kind="pod", action="readopt")
                 else:
@@ -224,6 +238,7 @@ class ControlPlaneReconciler:
             if not ok or live is None or not live.spec.node_name:
                 continue  # deleted/unbound since the list: not drift
             try:
+                _attach_volume_counts(self.sched, live)
                 cache.add_pod(live)
                 report.pods_readopted += 1
                 metrics.cache_drift.inc(kind="pod", action="readopt")

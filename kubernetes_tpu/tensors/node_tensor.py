@@ -25,6 +25,7 @@ solver JITs once per bucket, not per node-count (SURVEY.md section 7
 from __future__ import annotations
 
 import math
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -77,10 +78,24 @@ def _kib_ceil(b: int) -> int:
 class ResourceDims:
     """Resource name -> tensor column. Fixed dims 0-3; scalar/extended
     resources get columns as they first appear. Growing the dim set bumps
-    ``version`` which invalidates packed tensors."""
+    ``version`` which invalidates packed tensors.
+
+    Attachable-volume count limits (``attachable-volumes-*``, see
+    cache/node_info.py) register through ``volume_column``: they share
+    the scalar column space -- the fit scan already treats any scalar
+    column with a zero request as "not requested" -- but are tracked
+    separately so the node packer knows to fill their allocatable from
+    CSINode limits / in-tree defaults and their requested from the
+    node's in-use counts rather than from the Resource aggregates.
+
+    Registration is thread-safe: the admission classifier registers
+    volume columns from informer threads while the dispatcher packs."""
 
     def __init__(self) -> None:
         self._scalar_cols: Dict[str, int] = {}
+        self._volume_names: set = set()
+        self._volume_cols_cache: Optional[Dict[str, int]] = None
+        self._reg_lock = threading.Lock()
         self.version = 0
 
     @property
@@ -101,10 +116,42 @@ class ResourceDims:
             return PODS
         col = self._scalar_cols.get(resource)
         if col is None:
-            col = NUM_FIXED_DIMS + len(self._scalar_cols)
-            self._scalar_cols[resource] = col
-            self.version += 1
+            with self._reg_lock:
+                col = self._scalar_cols.get(resource)
+                if col is None:
+                    col = NUM_FIXED_DIMS + len(self._scalar_cols)
+                    self._scalar_cols[resource] = col
+                    self.version += 1
         return col
+
+    def volume_column(self, resource: str) -> int:
+        """Register ``resource`` as an attachable-volume count column."""
+        col = self.column(resource)
+        if resource not in self._volume_names:
+            with self._reg_lock:
+                self._volume_names.add(resource)
+                self._volume_cols_cache = None
+        return col
+
+    def existing_column(self, resource: str) -> Optional[int]:
+        """Column for ``resource`` without growing the schema."""
+        return self._scalar_cols.get(resource)
+
+    def volume_columns(self) -> Dict[str, int]:
+        """name -> column for every registered volume-count resource
+        (cached; invalidated on registration). Built under the
+        registration lock so a concurrent volume_column() can never
+        mutate the name set mid-iteration; the returned dict is
+        replaced atomically and safe to read lock-free."""
+        cache = self._volume_cols_cache
+        if cache is None:
+            with self._reg_lock:
+                cache = {
+                    name: self._scalar_cols[name]
+                    for name in self._volume_names
+                }
+                self._volume_cols_cache = cache
+        return cache
 
     def encode_resource(self, r: Resource, *, ceil_bytes: bool) -> np.ndarray:
         kib = _kib_ceil if ceil_bytes else _kib_floor
@@ -206,6 +253,17 @@ class NodeTensorCache:
         self._alloc[i] = self.dims.encode_resource(ni.allocatable, ceil_bytes=False)
         req = self.dims.encode_resource(ni.requested, ceil_bytes=True)
         req[PODS] = len(ni.pods)
+        vol_cols = self.dims.volume_columns()
+        if vol_cols:
+            # attachable-volume columns: allocatable = CSINode limit /
+            # in-tree default / unlimited; requested = additive in-use
+            # count from resident pods (cache/node_info.py). Volume-free
+            # pods skip these dims in the fit scan (zero request).
+            viu = ni.volume_in_use
+            alloc_row = self._alloc[i]
+            for name, col in vol_cols.items():
+                alloc_row[col] = ni.volume_limit(name)
+                req[col] = viu.get(name, 0)
         self._req[i] = req
         self._nzr[i, 0] = ni.non_zero_requested.milli_cpu
         self._nzr[i, 1] = _kib_ceil(ni.non_zero_requested.memory)
@@ -236,6 +294,10 @@ class NodeTensorCache:
                 self.dims.column(name)
             for name in ni.requested.scalar:
                 self.dims.column(name)
+            for name in ni.csi_volume_limits:
+                self.dims.volume_column(name)
+            for name in ni.volume_in_use:
+                self.dims.volume_column(name)
         schema_moved = (
             self.dims.version != self._dims_version
             or self.topology.version != self._topo_version
@@ -319,11 +381,22 @@ def pack_pod_batch(
         # path's assume/bind clones copy __dict__, so the memo rides into
         # every clone and NodeInfo.add_pod never re-derives it
         pod_hot_info(pod)
-        key = tuple(req.items())
+        # resolved attachable-volume counts (admission classifier memo,
+        # scheduler/admission.py): they ride the request row as volume
+        # columns so the fit scan enforces per-node attach limits
+        vc = pod.__dict__.get("_volcount_memo") or ()
+        key = (tuple(req.items()), vc)
         u = row_cache.get(key)
         if u is None:
             row, unknown = dims.encode_requests(req, grow=False)
             row[PODS] = 1
+            for name, qty in vc:
+                col = dims.existing_column(name)
+                if col is not None:
+                    # unregistered names (a nominee classified by an
+                    # older scheduler instance) are skipped: the overlay
+                    # under-reserves rather than shape-mismatching
+                    row[col] += qty
             u = len(uniq_rows)
             uniq_rows.append(row)
             uniq_unknown.append(unknown)

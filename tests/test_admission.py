@@ -1,0 +1,285 @@
+"""Admission-classifier tests: ingest-time classification, memo reuse on
+the dispatch hot path, and the stale-classification edges (a PVC binding
+landing mid-queue, a queued pod's volumes mutating) that MUST re-classify
+instead of dispatching under the cached class."""
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    CSINode,
+    CSINodeDriver,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+@pytest.fixture
+def stack():
+    """Pump-mode stack: informer events drain synchronously on the test
+    thread, so classification timing is deterministic."""
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(client, informers, batch=True, max_batch=16)
+    yield server, client, informers, sched
+    sched.stop()
+    informers.stop()
+
+
+def _bound_csi_pv(server, claim, volume, driver="ebs.csi.aws.com"):
+    server.create(
+        PersistentVolumeClaim(
+            metadata=ObjectMeta(name=claim, namespace="default"),
+            volume_name=volume,
+            requested_bytes=1 << 30,
+        )
+    )
+    server.create(
+        PersistentVolume(
+            metadata=ObjectMeta(name=volume, namespace=""),
+            capacity_bytes=1 << 30,
+            claim_ref_namespace="default",
+            claim_ref_name=claim,
+            csi_driver=driver,
+            csi_volume_handle=volume,
+        )
+    )
+
+
+class TestClassification:
+    def test_plain_pod_is_device_class(self, stack):
+        _, _, _, sched = stack
+        adm = sched.classify_pod(make_pod("p").container(cpu="1").obj())
+        assert adm.device_ok
+        assert adm.reason == ""
+        assert adm.klass == "device"
+
+    def test_numa_annotation_is_host(self, stack):
+        _, _, _, sched = stack
+        pod = make_pod("p").container(cpu="1").obj()
+        pod.metadata.annotations["numa.kubernetes-tpu.io/aligned"] = "2"
+        adm = sched.classify_pod(pod)
+        assert not adm.device_ok
+        assert adm.reason == "numa-aligned"
+        assert adm.klass == "host"
+
+    def test_direct_volume_source_is_host_but_counted(self, stack):
+        _, _, _, sched = stack
+        adm = sched.classify_pod(
+            make_pod("p").container(cpu="1").gce_pd("disk-1").obj()
+        )
+        assert not adm.device_ok
+        assert adm.reason == "direct-volume-source"
+        assert adm.vol_counts == (("attachable-volumes-gce-pd", 1),)
+
+    def test_constrained_shapes_keep_device_class(self, stack):
+        _, _, _, sched = stack
+        adm = sched.classify_pod(
+            make_pod("p").container(cpu="1")
+            .pod_affinity("zone", {"a": "b"}, anti=True).obj()
+        )
+        assert adm.device_ok
+        assert adm.required_anti and adm.affinity_req
+        assert adm.klass == "constrained"
+
+    def test_bound_csi_pvc_is_device_with_counts(self, stack):
+        server, client, informers, sched = stack
+        _bound_csi_pv(server, "c1", "v1")
+        informers.pump()
+        pod = make_pod("p").container(cpu="1").pvc("c1").obj()
+        adm = sched._admission_of(pod)
+        assert adm.device_ok, adm.reason
+        assert adm.vol_counts == (
+            ("attachable-volumes-csi-ebs.csi.aws.com", 1),
+        )
+        assert adm.has_pvc
+        # the in-use accounting memo landed alongside
+        assert pod.__dict__["_volcount_memo"] == adm.vol_counts
+        # and the pop-time read registered the volume column with the
+        # tensor schema (dispatcher-thread registration; classify_pod
+        # itself must not grow dims from informer threads)
+        dims = sched.tensor_cache.dims
+        assert (
+            "attachable-volumes-csi-ebs.csi.aws.com" in dims.volume_columns()
+        )
+
+    def test_unbound_pvc_is_host(self, stack):
+        _, _, _, sched = stack
+        adm = sched.classify_pod(
+            make_pod("p").container(cpu="1").pvc("nope").obj()
+        )
+        assert not adm.device_ok
+        assert adm.reason == "unbound-pvc"
+
+    def test_memo_reused_on_hot_path(self, stack):
+        _, _, _, sched = stack
+        pod = make_pod("p").container(cpu="1").obj()
+        a1 = sched._admission_of(pod)
+        n = sched.admissions_classified
+        a2 = sched._admission_of(pod)
+        assert a1 is a2
+        assert sched.admissions_classified == n
+
+
+class TestStaleClassification:
+    def test_pvc_binding_mid_queue_reclassifies(self, stack):
+        """Satellite: a pod classified host-only (unbound claim) whose
+        PVC binding lands while it waits in the queue must be
+        re-classified at pop time -- the volume-topology generation bump
+        invalidates the cached record."""
+        server, client, informers, sched = stack
+        client.create_node(
+            make_node("n0").capacity(cpu="8", memory="16Gi").obj()
+        )
+        informers.pump()
+        client.create_pod(
+            make_pod("p").container(cpu="1").pvc("c1").obj()
+        )
+        informers.pump()
+        queued = sched.queue.pending_pods()
+        assert len(queued) == 1
+        adm = queued[0].__dict__["_admission"]
+        assert not adm.device_ok and adm.reason == "unbound-pvc"
+
+        # the binding lands mid-queue (PVC + PV events bump the gen)
+        gen_before = sched._volume_topo_gen
+        _bound_csi_pv(server, "c1", "v1")
+        informers.pump()
+        assert sched._volume_topo_gen > gen_before
+
+        # pop-time admission re-classifies instead of trusting the memo
+        reclass_before = sched.reclassifications
+        adm2 = sched._admission_of(queued[0])
+        assert sched.reclassifications == reclass_before + 1
+        assert adm2.device_ok, adm2.reason
+        assert adm2.vol_counts == (
+            ("attachable-volumes-csi-ebs.csi.aws.com", 1),
+        )
+
+    def test_mutated_volumes_reclassify(self, stack):
+        """Satellite: updating a queued pod's volumes replaces the pod
+        object in the queue; the new object is classified on ingest and
+        dispatch must route it by the NEW class."""
+        server, client, informers, sched = stack
+        client.create_node(
+            make_node("n0").capacity(cpu="8", memory="16Gi").obj()
+        )
+        informers.pump()
+        client.create_pod(make_pod("p").container(cpu="1").obj())
+        informers.pump()
+        queued = sched.queue.pending_pods()[0]
+        assert queued.__dict__["_admission"].device_ok
+
+        updated = queued.deepcopy()
+        updated.spec.volumes = (
+            make_pod("tmp").gce_pd("disk-1").obj().spec.volumes
+        )
+        client.update_pod(updated)
+        informers.pump()
+        queued2 = sched.queue.pending_pods()[0]
+        assert queued2 is not queued
+        adm = queued2.__dict__["_admission"]
+        assert not adm.device_ok
+        assert adm.reason == "direct-volume-source"
+
+        # and the dispatcher actually routes it to the host path
+        sched.queue.run()
+        n_fallback = sched.pods_fallback
+        sched.schedule_batch(timeout=0.1)
+        sched.wait_for_inflight_binds()
+        assert sched.pods_fallback == n_fallback + 1
+
+    def test_foreign_token_reclassifies(self, stack):
+        """A memo written by another scheduler instance (different
+        extenders / dims registry) is never trusted."""
+        _, _, _, sched = stack
+        pod = make_pod("p").container(cpu="1").obj()
+        adm = sched.classify_pod(pod)
+        adm.token = object()  # simulate a foreign owner
+        n = sched.admissions_classified
+        adm2 = sched._admission_of(pod)
+        assert adm2 is not adm
+        assert sched.admissions_classified == n + 1
+
+
+class TestIngestClassification:
+    def test_burst_classified_on_ingest_not_dispatch(self, stack):
+        """The dispatch loop must be a memo read: after ingest, popping
+        and routing the batch classifies nothing new."""
+        server, client, informers, sched = stack
+        client.create_node(
+            make_node("n0").capacity(cpu="32", memory="64Gi").obj()
+        )
+        informers.pump()
+        for i in range(10):
+            client.create_pod(
+                make_pod(f"p{i}").container(cpu="100m").obj()
+            )
+        informers.pump()
+        assert sched.admissions_classified >= 10
+        n = sched.admissions_classified
+        batch = sched.queue.pop_batch(16)
+        assert len(batch) == 10
+        for pi in batch:
+            assert sched._admission_of(pi.pod).device_ok
+        assert sched.admissions_classified == n
+
+
+class TestCSINodeCache:
+    def test_csi_node_limits_reach_node_info(self, stack):
+        server, client, informers, sched = stack
+        client.create_node(
+            make_node("n0").capacity(cpu="8", memory="16Gi").obj()
+        )
+        server.create(
+            CSINode(
+                metadata=ObjectMeta(name="n0", namespace=""),
+                drivers=[
+                    CSINodeDriver(
+                        name="ebs.csi.aws.com", node_id="n0",
+                        allocatable_count=3,
+                    )
+                ],
+            )
+        )
+        informers.pump()
+        ni = sched.cache._nodes["n0"]
+        assert ni.csi_volume_limits == {
+            "attachable-volumes-csi-ebs.csi.aws.com": 3
+        }
+        assert ni.volume_limit(
+            "attachable-volumes-csi-ebs.csi.aws.com"
+        ) == 3
+        # unknown driver -> unlimited; in-tree -> reference default
+        from kubernetes_tpu.cache.node_info import VOLUME_UNLIMITED
+
+        assert ni.volume_limit(
+            "attachable-volumes-csi-other"
+        ) == VOLUME_UNLIMITED
+        assert ni.volume_limit("attachable-volumes-aws-ebs") == 39
+
+    def test_csi_node_before_node_applies_on_add(self, stack):
+        server, client, informers, sched = stack
+        server.create(
+            CSINode(
+                metadata=ObjectMeta(name="late", namespace=""),
+                drivers=[
+                    CSINodeDriver(
+                        name="d", node_id="late", allocatable_count=5
+                    )
+                ],
+            )
+        )
+        informers.pump()
+        client.create_node(
+            make_node("late").capacity(cpu="8", memory="16Gi").obj()
+        )
+        informers.pump()
+        ni = sched.cache._nodes["late"]
+        assert ni.csi_volume_limits == {"attachable-volumes-csi-d": 5}

@@ -1,0 +1,59 @@
+"""Unit tests for bench.py's trial protocol helpers (the noise-robust
+median headline; see the bench module docstring)."""
+
+import importlib.util
+import os
+
+_BENCH_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py"
+)
+_spec = importlib.util.spec_from_file_location("bench_module", _BENCH_PATH)
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def _trial(n, pps, p99):
+    return {
+        "trial": n, "pods_per_sec": pps, "p99_pod_to_bind_ms": p99,
+    }
+
+
+def test_median_odd_count():
+    trials = [_trial(1, 100.0, 50), _trial(2, 300.0, 20), _trial(3, 200.0, 30)]
+    assert bench.pick_median_trial(trials)["trial"] == 3
+
+
+def test_median_even_count_picks_conservative_middle():
+    trials = [
+        _trial(1, 100.0, 50), _trial(2, 400.0, 10),
+        _trial(3, 200.0, 30), _trial(4, 300.0, 20),
+    ]
+    # lower middle of the throughput ranking: 200 pods/s
+    assert bench.pick_median_trial(trials)["trial"] == 3
+
+
+def test_median_single_trial():
+    trials = [_trial(1, 123.0, 45)]
+    assert bench.pick_median_trial(trials) is trials[0]
+
+
+def test_noisy_outlier_cannot_move_headline():
+    """The satellite's point: one noisy capture (slow trial, huge p99)
+    must not become the recorded number."""
+    trials = [
+        _trial(1, 24000.0, 400.0),
+        _trial(2, 5000.0, 900.0),  # driver hiccup
+        _trial(3, 24500.0, 390.0),
+    ]
+    med = bench.pick_median_trial(trials)
+    assert med["trial"] == 1
+    assert med["p99_pod_to_bind_ms"] < 500
+
+
+def test_trials_flag_defaults():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    # mirror of bench.main's registration: default 3 measured trials
+    ap.add_argument("--trials", type=int, default=3)
+    assert ap.parse_args([]).trials == 3

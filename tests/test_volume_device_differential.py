@@ -1,0 +1,198 @@
+"""Randomized batch-vs-sequential differential for the volume-count
+device columns (same pattern as tests/test_score_differential.py): pods
+whose attachable-volume limits used to force the sequential host path
+now solve on device via the ``[N, R]`` volume columns, and must place
+IDENTICALLY to the host oracle (CSILimits / in-tree unique-handle sets),
+including:
+
+- the over-capacity reject case (more volumes than the cluster's attach
+  slots -> the same pods stay unschedulable on both paths), and
+- the CSINode-absent migration fallback (no CSINode -> no limit known ->
+  both paths admit; csi.go:72).
+
+Handles are distinct per pod, where the additive device counting and the
+oracle's per-node-unique sets provably agree; shared-handle pods are the
+documented conservative case (device rejects re-check on the host path).
+"""
+
+import random
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    CSINode,
+    CSINodeDriver,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+NUM_NODES = 8
+NUM_PODS = 20
+
+
+class _KeepFirstRng:
+    def randrange(self, n):
+        return 1 if n > 1 else 0
+
+    def randint(self, a, b):
+        return b
+
+
+def _build_cluster(server, client, *, csi_limit, with_csi_nodes, rng):
+    for i in range(NUM_NODES):
+        client.create_node(
+            make_node(f"n{i}")
+            .capacity(cpu=str(8 + 2 * i), memory=f"{16 + 5 * i}Gi")
+            .obj()
+        )
+        if with_csi_nodes:
+            server.create(
+                CSINode(
+                    metadata=ObjectMeta(name=f"n{i}", namespace=""),
+                    drivers=[
+                        CSINodeDriver(
+                            name="ebs.csi.aws.com",
+                            node_id=f"n{i}",
+                            allocatable_count=csi_limit,
+                        )
+                    ],
+                )
+            )
+
+
+def _build_pods(server, rng):
+    """Pods with 1-2 bound countable PVs each: mostly CSI, some in-tree
+    EBS via PV. Distinct handles per pod. Creation timestamps fix the
+    solve order on both paths."""
+    pods = []
+    for i in range(NUM_PODS):
+        w = (
+            make_pod(f"m{i}")
+            .creation_timestamp(float(i))
+            .container(
+                cpu=f"{rng.choice([100, 200, 400])}m",
+                memory=f"{rng.choice([128, 256])}Mi",
+            )
+        )
+        for k in range(rng.choice([1, 1, 2])):
+            cn = f"pvc-m{i}-{k}"
+            vn = f"pv-m{i}-{k}"
+            server.create(
+                PersistentVolumeClaim(
+                    metadata=ObjectMeta(name=cn, namespace="default"),
+                    volume_name=vn,
+                    requested_bytes=1 << 30,
+                )
+            )
+            pv = PersistentVolume(
+                metadata=ObjectMeta(name=vn, namespace=""),
+                capacity_bytes=1 << 30,
+                claim_ref_namespace="default",
+                claim_ref_name=cn,
+            )
+            if rng.random() < 0.75:
+                pv.csi_driver = "ebs.csi.aws.com"
+                pv.csi_volume_handle = vn
+            else:
+                pv.aws_ebs_volume_id = vn
+            server.create(pv)
+            w.pvc(cn)
+        pods.append(w.obj())
+    return pods
+
+
+def _wait_decided(client, sched, count, timeout=120.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        pods, _ = client.list_pods()
+        pending = [
+            p for p in pods
+            if not p.spec.node_name and not p.status.conditions
+        ]
+        if len(pods) >= count and not pending:
+            sched.wait_for_inflight_binds()
+            return client.list_pods()[0]
+        time.sleep(0.05)
+    raise AssertionError("pods not decided in time")
+
+
+def _run(seed, *, batch, csi_limit, with_csi_nodes):
+    rng = random.Random(seed)
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(
+        client, informers, batch=batch, max_batch=32,
+        rng=_KeepFirstRng(),
+    )
+    _build_cluster(
+        server, client, csi_limit=csi_limit,
+        with_csi_nodes=with_csi_nodes, rng=rng,
+    )
+    pods = _build_pods(server, rng)
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    for p in pods:
+        client.create_pod(p)
+    sched.start()
+    decided = _wait_decided(client, sched, NUM_PODS)
+    placements = {
+        p.metadata.name: p.spec.node_name
+        for p in decided
+        if p.metadata.name.startswith("m")
+    }
+    stats = {
+        "fallback": getattr(sched, "pods_fallback", None),
+        "on_device": getattr(sched, "pods_solved_on_device", None),
+        "vol_retries": getattr(sched, "volume_reject_retries", None),
+    }
+    sched.stop()
+    informers.stop()
+    return placements, stats
+
+
+@pytest.mark.parametrize("seed", [7, 23])
+def test_volume_columns_match_host_oracle(seed):
+    """Capacity-comfortable case: every pod fits under the per-node
+    attach limits; batch placements must equal the sequential oracle's,
+    with zero host fallbacks on the batch side."""
+    want, _ = _run(seed, batch=False, csi_limit=6, with_csi_nodes=True)
+    got, stats = _run(seed, batch=True, csi_limit=6, with_csi_nodes=True)
+    assert all(want.values()), "oracle failed to place a fitting pod"
+    assert got == want
+    assert stats["fallback"] == 0, stats
+
+
+@pytest.mark.parametrize("seed", [11])
+def test_over_capacity_rejects_match(seed):
+    """Attach slots < total volumes: the SAME pods must end up
+    unschedulable on both paths (the batch path re-checks device rejects
+    on the host oracle before declaring failure)."""
+    want, _ = _run(seed, batch=False, csi_limit=1, with_csi_nodes=True)
+    got, stats = _run(seed, batch=True, csi_limit=1, with_csi_nodes=True)
+    assert any(not v for v in want.values()), (
+        "expected an over-capacity reject in the oracle run"
+    )
+    assert got == want
+    # rejects were re-checked on the host path, not failed blind
+    assert stats["vol_retries"] >= 1, stats
+
+
+@pytest.mark.parametrize("seed", [5])
+def test_csi_node_absent_falls_open(seed):
+    """No CSINode objects -> no limits known -> both paths admit
+    everything (nodevolumelimits/csi.go:72), still identically placed
+    and fully on device."""
+    want, _ = _run(seed, batch=False, csi_limit=0, with_csi_nodes=False)
+    got, stats = _run(seed, batch=True, csi_limit=0, with_csi_nodes=False)
+    assert all(want.values())
+    assert got == want
+    assert stats["fallback"] == 0, stats
