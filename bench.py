@@ -20,9 +20,12 @@ trial followed by K measured trials against the same warmed stack, and
 reports the MEDIAN trial (by pods/s) as the headline numbers -- a single
 noisy driver capture can no longer push the recorded p99 over the bar.
 Every per-trial record rides in the payload's "trials" list.
-``--profile`` adds a per-stage wall-clock breakdown (pop_batch /
-classify / pack / device_solve / download / commit) to each trial so a
-regression is attributable without a re-run bisect.
+Each trial (and the headline) always carries ``profile_stage_seconds``
+-- the per-stage wall-clock breakdown (pop_batch / pack / device_solve /
+download / commit; timers are per-thread accumulators, always on) -- so
+a stage regression is attributable from the recorded trajectory without
+a re-run bisect. ``--profile`` additionally times the per-pod classify
+stage.
 
 Env knobs: BENCH_NODES (default 5000), BENCH_PODS (default 10000),
 BENCH_BATCH (default 4096 -- the sweep winner: 2048 leaves round-trip
@@ -257,13 +260,17 @@ def _stage_delta(sched, before):
     }
 
 
-def run_burst_trial(
-    sched, client, server, num_pods, trial, profile=False
-):
+def run_burst_trial(sched, client, server, num_pods, trial):
     """One measured 10k-pod burst through the warmed stack. Returns a
     per-trial record or raises AssertionError when pods don't complete.
     Trials accumulate their bound pods on the cluster (steady-state-like
-    fill); capacity comfortably covers the default trial counts."""
+    fill); capacity comfortably covers the default trial counts.
+
+    The per-stage wall-clock breakdown rides in EVERY trial record: the
+    scheduler's stage timers are always on (per-thread accumulators,
+    nearly free), so stage regressions show up in the recorded
+    trajectory without a --profile re-run. ``--profile`` only adds the
+    per-pod classify timer."""
     from kubernetes_tpu.testing import make_pod
     from kubernetes_tpu.utils import timeline
 
@@ -276,7 +283,7 @@ def run_burst_trial(
     burst_names = {p.metadata.name for p in burst}
     watcher = BindWatcher(server, burst_names)
     create_times = {}
-    stage_before = dict(sched.stage_seconds) if profile else {}
+    stage_before = dict(sched.stage_seconds)
     # parallel creators: the burst arrives through the API as fast as the
     # store can take it, overlapping serialization with the solve pipeline
     # (on a single-core host extra creator threads only add GIL ping-pong)
@@ -336,9 +343,8 @@ def run_burst_trial(
         "elapsed_s": round(elapsed, 3),
         "p50_pod_to_bind_ms": round(p50 * 1000, 1),
         "p99_pod_to_bind_ms": round(p99 * 1000, 1),
+        "profile_stage_seconds": _stage_delta(sched, stage_before),
     }
-    if profile:
-        record["profile_stage_seconds"] = _stage_delta(sched, stage_before)
     return record
 
 
@@ -369,8 +375,9 @@ def main() -> None:
     ap.add_argument(
         "--profile", action="store_true",
         default=os.environ.get("BENCH_PROFILE", "") == "1",
-        help="per-stage wall-clock breakdown (pop_batch / classify / "
-        "pack / device_solve / download / commit) in each trial record",
+        help="add the per-pod classify timer to the always-on stage "
+        "breakdown (pop_batch / pack / device_solve / download / "
+        "commit, emitted as profile_stage_seconds in every record)",
     )
     args = ap.parse_args()
 
@@ -455,10 +462,7 @@ def main() -> None:
     trials = []
     try:
         for trial in range(num_trials + 1):
-            rec = run_burst_trial(
-                sched, client, server, num_pods, trial,
-                profile=args.profile,
-            )
+            rec = run_burst_trial(sched, client, server, num_pods, trial)
             if trial == 0:
                 rec["discarded_warmup"] = True
                 print(json.dumps(rec), file=sys.stderr)
@@ -497,11 +501,12 @@ def main() -> None:
         "p99_pod_to_bind_ms": median["p99_pod_to_bind_ms"],
         "median_trial": median["trial"],
         "trials": trials,
+        # always present (stage timers are always on): the recorded
+        # BENCH_*.json trajectory carries the stage shares every round,
+        # so a pop/pack/commit regression is attributable without a
+        # --profile re-run bisect
+        "profile_stage_seconds": median.get("profile_stage_seconds", {}),
     }
-    if args.profile:
-        record["profile_stage_seconds"] = median.get(
-            "profile_stage_seconds", {}
-        )
     if fault_profile:
         # chaos runs report the degradation profile next to throughput
         record["fault_profile"] = fault_profile

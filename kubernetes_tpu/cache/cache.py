@@ -64,10 +64,20 @@ class SchedulerCache:
     def assume_pods(self, pods: List[Pod]) -> List[Optional[Exception]]:
         """Bulk assume under one lock hold (the batch-commit analogue of N
         AssumePod calls). Per-pod failures don't abort the rest; slot i
-        carries pod i's error or None."""
+        carries pod i's error or None.
+
+        Consecutive same-node pods land as one ``NodeInfo.add_pods`` run
+        (one node lookup + one generation bump per run). The batch
+        committer maximizes the runs by argsorting its clones per target
+        node before calling; arbitrary order stays correct -- runs just
+        degenerate to length 1."""
         out: List[Optional[Exception]] = []
         with self._lock:
             states = self._pod_states
+            assumed = self._assumed_pods
+            nodes = self._nodes
+            run: List[Pod] = []
+            run_node: Optional[str] = None
             for pod in pods:
                 key = pod.metadata.uid
                 if key in states:
@@ -75,11 +85,29 @@ class SchedulerCache:
                         KeyError(f"pod {pod.key()} is already in the cache")
                     )
                     continue
-                self._add_pod_to_node(pod)
+                node = pod.spec.node_name
+                if node != run_node:
+                    if run:
+                        self._node_for(nodes, run_node).add_pods(run)
+                    run = []
+                    run_node = node
+                run.append(pod)
                 states[key] = _PodState(pod=pod, assumed=True)
-                self._assumed_pods[key] = True
+                assumed[key] = True
                 out.append(None)
+            if run:
+                self._node_for(nodes, run_node).add_pods(run)
         return out
+
+    @staticmethod
+    def _node_for(nodes, name) -> NodeInfo:
+        ni = nodes.get(name)
+        if ni is None:
+            # pod observed before its node: nodeless NodeInfo, matching
+            # _add_pod_to_node
+            ni = NodeInfo()
+            nodes[name] = ni
+        return ni
 
     def finish_binding(self, pod: Pod) -> None:
         key = pod.metadata.uid

@@ -294,6 +294,46 @@ class NodeInfo:
                 viu[name] = viu.get(name, 0) + qty
         self.generation = next_generation()
 
+    def add_pods(self, pods: List[Pod]) -> None:
+        """Bulk add for ONE node: identical accounting to N ``add_pod``
+        calls with the resource accumulation held in locals and a single
+        generation bump for the whole run (the batch committer lands
+        node-grouped assume runs here; the tensor cache's
+        generation-compare repack sees one change either way)."""
+        req = self.requested
+        nzr = self.non_zero_requested
+        milli = mem_b = eph = 0
+        nzr_cpu = nzr_mem = 0
+        for pod in pods:
+            (
+                milli_i, mem_i, eph_i, scalars, cpu, mem, has_aff, ports,
+            ) = pod_hot_info(pod)
+            milli += milli_i
+            mem_b += mem_i
+            eph += eph_i
+            nzr_cpu += cpu
+            nzr_mem += mem
+            if scalars:
+                sc = req.scalar
+                for name, qty in scalars:
+                    sc[name] = sc.get(name, 0) + qty
+            if has_aff:
+                self.pods_with_affinity.append(pod)
+            for ip, proto, port in ports:
+                self.used_ports.add(ip, proto, port)
+            vc = pod.__dict__.get("_volcount_memo")
+            if vc:
+                viu = self.volume_in_use
+                for name, qty in vc:
+                    viu[name] = viu.get(name, 0) + qty
+        req.milli_cpu += milli
+        req.memory += mem_b
+        req.ephemeral_storage += eph
+        nzr.milli_cpu += nzr_cpu
+        nzr.memory += nzr_mem
+        self.pods.extend(pods)
+        self.generation = next_generation()
+
     def remove_pod(self, pod: Pod) -> bool:
         for i, p in enumerate(self.pods):
             if p.metadata.uid == pod.metadata.uid:

@@ -78,3 +78,4 @@ cow_clone = getattr(hotpath, "cow_clone", None)
 #: one-call commit-path loops (see _hotpath.c "bulk commit spine")
 assume_clones = getattr(hotpath, "assume_clones", None)
 bind_assumed_bulk = getattr(hotpath, "bind_assumed_bulk", None)
+commit_gather = getattr(hotpath, "commit_gather", None)

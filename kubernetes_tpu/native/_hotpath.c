@@ -370,6 +370,87 @@ fail:
     return NULL;
 }
 
+static PyObject *str_pod = NULL;
+
+static PyObject *
+commit_gather(PyObject *self, PyObject *args)
+{
+    /* commit_gather(solver_infos, order, assignments, names)
+     *   -> (pod_infos, clones, hosts)
+     *
+     * One C pass over a solved batch's PLACED slots (the committer
+     * splits NO_NODE slots off with numpy before calling): slot j
+     * gathers pod_info = solver_infos[order[j]], resolves
+     * host = names[assignments[j]], and builds the assumed clone
+     * (shallow pod + shallow spec with spec.node_name = host) in the
+     * same step -- fusing the commit loop's gather with the
+     * assume_clones pass so the per-pod Python work of the bulk commit
+     * is three parallel C-built lists. order/assignments are plain int
+     * lists (numpy .tolist() output); semantics match the Python
+     * fallback in scheduler/batch.py (_commit_gather_py),
+     * differentially tested in tests/test_native_commit.py. */
+    PyObject *infos, *order, *assigns, *names;
+    if (!PyArg_ParseTuple(args, "O!O!O!O!", &PyList_Type, &infos,
+                          &PyList_Type, &order, &PyList_Type, &assigns,
+                          &PyList_Type, &names))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(order);
+    if (PyList_GET_SIZE(assigns) != n) {
+        PyErr_SetString(PyExc_ValueError, "order/assignments length mismatch");
+        return NULL;
+    }
+    Py_ssize_t n_infos = PyList_GET_SIZE(infos);
+    Py_ssize_t n_names = PyList_GET_SIZE(names);
+    PyObject *pis = PyList_New(n);
+    PyObject *clones = PyList_New(n);
+    PyObject *hosts = PyList_New(n);
+    if (pis == NULL || clones == NULL || hosts == NULL)
+        goto fail;
+    for (Py_ssize_t j = 0; j < n; j++) {
+        long oi = PyLong_AsLong(PyList_GET_ITEM(order, j));
+        long ci = PyLong_AsLong(PyList_GET_ITEM(assigns, j));
+        if ((oi == -1 || ci == -1) && PyErr_Occurred())
+            goto fail;
+        if (oi < 0 || oi >= n_infos || ci < 0 || ci >= n_names) {
+            PyErr_SetString(PyExc_IndexError,
+                            "commit_gather index out of range");
+            goto fail;
+        }
+        PyObject *pi = PyList_GET_ITEM(infos, oi);
+        PyObject *host = PyList_GET_ITEM(names, ci);
+        PyObject *pod = PyObject_GetAttr(pi, str_pod);
+        if (pod == NULL)
+            goto fail;
+        PyObject *spec = PyObject_GetAttr(pod, str_spec);
+        if (spec == NULL) {
+            Py_DECREF(pod);
+            goto fail;
+        }
+        PyObject *specc = clone_with_dict(spec, str_node_name, host, NULL);
+        Py_DECREF(spec);
+        if (specc == NULL) {
+            Py_DECREF(pod);
+            goto fail;
+        }
+        PyObject *podc = clone_with_dict(pod, str_spec, specc, NULL);
+        Py_DECREF(specc);
+        Py_DECREF(pod);
+        if (podc == NULL)
+            goto fail;
+        Py_INCREF(pi);
+        PyList_SET_ITEM(pis, j, pi);
+        PyList_SET_ITEM(clones, j, podc);
+        Py_INCREF(host);
+        PyList_SET_ITEM(hosts, j, host);
+    }
+    return Py_BuildValue("(NNN)", pis, clones, hosts);
+fail:
+    Py_XDECREF(pis);
+    Py_XDECREF(clones);
+    Py_XDECREF(hosts);
+    return NULL;
+}
+
 static PyObject *
 bind_assumed_bulk(PyObject *self, PyObject *args)
 {
@@ -702,6 +783,9 @@ static PyMethodDef methods[] = {
     {"assume_clones", assume_clones, METH_VARARGS,
      "assume_clones(pods, hosts) -> [assumed clone with spec.node_name "
      "set]"},
+    {"commit_gather", commit_gather, METH_VARARGS,
+     "commit_gather(solver_infos, order, assignments, names) -> "
+     "(pod_infos, clones, hosts)"},
     {"bind_assumed_bulk", bind_assumed_bulk, METH_VARARGS,
      "bind_assumed_bulk(store, assumed_list, rv, event_cls) -> "
      "(errors, events, new_rv)"},
@@ -727,10 +811,11 @@ PyInit__hotpath(void)
     str_resource_version = PyUnicode_InternFromString("resource_version");
     str_sig_memo = PyUnicode_InternFromString("_sig_memo");
     str_modified = PyUnicode_InternFromString("MODIFIED");
+    str_pod = PyUnicode_InternFromString("pod");
     if (str_dict == NULL || str_spec == NULL || str_node_name == NULL ||
         str_metadata == NULL || str_namespace == NULL ||
         str_name == NULL || str_uid == NULL || str_resource_version == NULL ||
-        str_sig_memo == NULL || str_modified == NULL)
+        str_sig_memo == NULL || str_modified == NULL || str_pod == NULL)
         return NULL;
     return PyModule_Create(&moduledef);
 }

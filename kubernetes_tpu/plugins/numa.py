@@ -165,6 +165,15 @@ class NodeResourcesNumaAligned(Plugin):
         cap = max(free[gi], 1)
         return int(100 * (cap - leftover) / cap), None
 
+    def reserve_relevant(self, pod: Pod) -> bool:
+        """Bulk-commit fast-path predicate: reserve() is a no-op for
+        pods without the single-NUMA-alignment opt-in annotation (the
+        ``not want`` early return below). Declaring it lets the batch
+        committer keep annotation-free pods on the bulk assume path
+        instead of running a per-pod Reserve pipeline for a guaranteed
+        no-op."""
+        return bool(aligned_resource(pod))
+
     def reserve(
         self, state: CycleState, pod: Pod, node_name: str
     ) -> Optional[Status]:

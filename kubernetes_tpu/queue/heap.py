@@ -36,6 +36,9 @@ class Heap:
         if less is None and sort_key is None:
             raise ValueError("need less or sort_key")
         self._key = key_func
+        # native sort keys (comparable tuples) make bulk drains a single
+        # C-level sort; the less-adapter path keeps the per-pop loop
+        self._native_keys = sort_key is not None
         if sort_key is not None:
             self._sort_key = sort_key
         else:
@@ -115,6 +118,54 @@ class Heap:
         obj = item[2][0]
         del self._entries[self._key(obj)]
         return obj
+
+    def pop_bulk(self, max_n: int) -> List[Any]:
+        """Remove and return up to ``max_n`` live objects in exact pop
+        order -- the bulk drain behind ``PriorityQueue.pop_batch``.
+
+        With native sort keys one C-level ``sorted`` over the
+        ``[key, seq, entry]`` items replaces max_n heappops (each of
+        which pays O(log n) plus interpreter-level dead-entry and dict
+        bookkeeping per call); the unique ``seq`` makes the order total,
+        so sorted order IS heappop order. The sorted remainder satisfies
+        the heap invariant and becomes the new heap directly, and dead
+        entries crossed on the way out are dropped -- compaction rides
+        the drain for free. Small drains from a much larger heap keep
+        the heappop loop (k log n beats a full n log n sort there), and
+        the arbitrary-``less`` adapter path always uses it: comparator
+        ties make sort-vs-heappop order implementation-defined, and the
+        pop loop is the contract."""
+        if max_n <= 0 or not self._entries:
+            return []
+        out: List[Any] = []
+        entries = self._entries
+        key = self._key
+        if not self._native_keys or max_n * 8 < len(self._heap):
+            heap = self._heap
+            pop = heapq.heappop
+            while heap and len(out) < max_n:
+                entry = pop(heap)[2]
+                if entry[1]:
+                    obj = entry[0]
+                    del entries[key(obj)]
+                    out.append(obj)
+                else:
+                    self._dead -= 1
+            return out
+        items = sorted(self._heap)
+        i = 0
+        n = len(items)
+        while i < n and len(out) < max_n:
+            entry = items[i][2]
+            i += 1
+            if entry[1]:
+                obj = entry[0]
+                del entries[key(obj)]
+                out.append(obj)
+            else:
+                self._dead -= 1
+        self._heap = items[i:]
+        return out
 
     def list(self) -> List[Any]:
         return [entry[0] for entry in self._entries.values()]

@@ -26,6 +26,7 @@ from __future__ import annotations
 import collections
 import logging
 import math
+import os
 import threading
 import time
 from typing import List, Optional, Tuple
@@ -101,8 +102,62 @@ from kubernetes_tpu.utils import timeline
 
 try:
     from kubernetes_tpu.native import assume_clones as _assume_clones
+    from kubernetes_tpu.native import commit_gather as _commit_gather
 except Exception:  # noqa: BLE001 - pure-Python fallback
     _assume_clones = None
+    _commit_gather = None
+
+
+def _commit_gather_py(solver_infos, order, assigns, names):
+    """Pure-Python fallback for native commit_gather: gather the placed
+    slots' PodInfos, build their assumed clones with spec.node_name set,
+    and resolve host names, in one pass (identical semantics to the C
+    loop; differentially tested in tests/test_native_commit.py)."""
+    pis, clones, hosts = [], [], []
+    for oi, ci in zip(order, assigns):
+        pi = solver_infos[oi]
+        host = names[ci]
+        assumed = pi.pod.assumed_clone()
+        assumed.spec.node_name = host
+        pis.append(pi)
+        clones.append(assumed)
+        hosts.append(host)
+    return pis, clones, hosts
+
+
+class _EagerDownload:
+    """Device->host result copy started at DISPATCH time on its own
+    daemon thread, so the transfer (and the numpy conversion) rides
+    concurrently with the next batch's pop/pack instead of serializing
+    inside the committer. ``result()`` blocks until the copy lands; the
+    committer calls it under the same wall-clock watchdog that guarded
+    the old in-committer ``np.asarray`` (a wedged serving link still
+    times out and trips the breaker)."""
+
+    __slots__ = ("_done", "_value", "_error")
+
+    def __init__(self, dev) -> None:
+        self._done = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+        threading.Thread(
+            target=self._run, args=(dev,), name="solve-download",
+            daemon=True,
+        ).start()
+
+    def _run(self, dev) -> None:
+        try:
+            self._value = np.asarray(dev)
+        except BaseException as e:  # noqa: BLE001 - re-raised in result()
+            self._error = e
+        finally:
+            self._done.set()
+
+    def result(self):
+        self._done.wait()
+        if self._error is not None:
+            raise self._error
+        return self._value
 
 logger = logging.getLogger(__name__)
 
@@ -116,7 +171,16 @@ POD_BUCKET = 64  # batch padded to a multiple of this to bound re-JITs
 #: supported envelope, like the reference's adaptive sampling regime.
 CONSTRAINED_NODE_CAP = 32768
 MASK_ROW_BUCKET = 8  # dedup static-mask rows padded to a multiple of this
-MAX_INFLIGHT = 3  # solver batches in flight between dispatcher and committer
+#: solver batches in flight between dispatcher and committer. With the
+#: result download riding its own thread from dispatch time
+#: (_EagerDownload) extra slots keep the committer fed instead of idling
+#: on the serving-link round trip -- but only when the host has cores to
+#: run them: on a 2-core box a deeper pipeline steals GIL time from the
+#: committer (measured ~10% slower at 4 in flight there), so the depth
+#: scales with the host instead of being raised unconditionally.
+MAX_INFLIGHT = max(3, min(6, (os.cpu_count() or 4) // 2))
+#: eager result downloads need a core to run on; see _eager_download
+_EAGER_DOWNLOAD_OK = (os.cpu_count() or 4) >= 4
 
 
 def solver_supported(pod: Pod) -> bool:
@@ -252,13 +316,17 @@ class BatchScheduler(Scheduler):
         self.admissions_classified = 0
         self.reclassifications = 0
         self.volume_reject_retries = 0  # device NO_NODE -> host re-checks
-        # per-stage wall-clock accumulators (bench.py --profile); the
-        # per-pod classify stage is only timed when profile_stages is on.
-        # Locked: the dispatcher (pop/classify/pack/device_solve) and the
-        # committer (download/commit) both accumulate
+        # per-stage wall-clock accumulators, ALWAYS on (bench.py emits
+        # profile_stage_seconds every round; only the per-pod classify
+        # timer stays behind profile_stages). Per-THREAD dicts merged at
+        # read: the dispatcher (pop/classify/pack/device_solve) and the
+        # committer (download/commit) accumulate without sharing a
+        # read-modify-write -- the old single dict dropped stage time
+        # under pipelining whenever both threads raced the same key
         self.profile_stages = False
-        self.stage_seconds: dict = {}
         self._stage_lock = threading.Lock()
+        self._stage_local = threading.local()
+        self._stage_dicts: List[dict] = []
         # collect-at-idle gc policy, engaged only by the production run
         # loop (tests driving schedule_batch directly keep gc untouched)
         self._gc_guard = None
@@ -294,7 +362,14 @@ class BatchScheduler(Scheduler):
         batch_infos = self.queue.pop_batch(
             self.max_batch, timeout=timeout, window=self.batch_window
         )
-        self._stage_add("pop_batch", time.perf_counter() - t_pop)
+        dt_pop = time.perf_counter() - t_pop
+        # split drain WORK from arrival wait: blocking on an empty queue
+        # (burst still streaming in, or plain idle) is not hot-path time
+        # and would drown the pop_batch share the profile exists to watch
+        waited = getattr(self.queue, "last_pop_wait_seconds", 0.0)
+        self._stage_add("pop_batch", max(0.0, dt_pop - waited))
+        if waited:
+            self._stage_add("pop_wait", waited)
         guard = self._gc_guard
         if not batch_infos:
             # idle: finish whatever is still in flight
@@ -407,7 +482,7 @@ class BatchScheduler(Scheduler):
         actual capacity outcome."""
         inactive: set = set()
         for _attempt in range(2):
-            assignments = np.asarray(pending["assignments_dev"])
+            assignments = self._pending_assignments(pending)
             failed = self._gang_quorum_failures(pending, assignments)
             failed -= inactive
             if not failed:
@@ -425,7 +500,7 @@ class BatchScheduler(Scheduler):
         # leftover failures after the final pass are committed as
         # NO_NODE without a re-solve: their capacity stays reserved in
         # the device output, so drop the carry
-        assignments = np.asarray(pending["assignments_dev"])
+        assignments = self._pending_assignments(pending)
         leftover = self._gang_quorum_failures(pending, assignments)
         if leftover - inactive:
             inactive |= leftover
@@ -433,6 +508,34 @@ class BatchScheduler(Scheduler):
                 self._dev.invalidate_carry()
         pending["gang_failed_uids"] = inactive
         return pending
+
+    def _pending_assignments(self, p):
+        """The batch's downloaded assignments for the gang fixup: await
+        the eager copy when one is in flight, else convert now -- under
+        the same wall-clock watchdog that guards the committer's
+        download, so a wedged serving link raises SolveTimeout (routed
+        through _solve_and_commit's recovery) instead of hanging the
+        dispatcher thread forever."""
+        tier = p.get("tier", TIER_XLA)
+        timeout = (
+            self.ladder.config.solve_timeout_seconds
+            if tier in (TIER_PALLAS, TIER_XLA) and self.ladder.config.enabled
+            else 0.0
+        )
+
+        def download():
+            eager = p.get("download")
+            if eager is not None:
+                return eager.result()
+            return np.asarray(p["assignments_dev"])
+
+        try:
+            return self.ladder.watchdog.call(download, timeout, tier=tier)
+        except SolveTimeout:
+            breaker = self.ladder.breakers.get(tier)
+            if breaker is not None:
+                breaker.force_open()
+            raise
 
     def _gang_quorum_failures(self, pending, assignments) -> set:
         """UIDs of every member of a group that cannot reach min_member:
@@ -593,10 +696,30 @@ class BatchScheduler(Scheduler):
         return out
 
     def _stage_add(self, name: str, seconds: float) -> None:
+        # lock-free on the hot path: each thread owns its accumulator
+        # dict; the lock is only taken once per thread to register it
+        d = getattr(self._stage_local, "d", None)
+        if d is None:
+            d = {}
+            self._stage_local.d = d
+            with self._stage_lock:
+                self._stage_dicts.append(d)
+        d[name] = d.get(name, 0.0) + seconds
+
+    @property
+    def stage_seconds(self) -> dict:
+        """Merged per-stage wall-clock totals across every accumulating
+        thread (dispatcher, committer, bind pool). dict.copy() is atomic
+        under the GIL, so a concurrent _stage_add never corrupts the
+        merge -- at worst the freshest increment lands in the next
+        read."""
         with self._stage_lock:
-            self.stage_seconds[name] = (
-                self.stage_seconds.get(name, 0.0) + seconds
-            )
+            dicts = [d.copy() for d in self._stage_dicts]
+        out: dict = {}
+        for d in dicts:
+            for k, v in d.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
 
     def _pending_has_ports(self) -> bool:
         with self._pending_cv:
@@ -1287,6 +1410,7 @@ class BatchScheduler(Scheduler):
                 "has_scoring_terms": has_scoring_terms,
                 "order": order,
                 "assignments_dev": assignments_dev,
+                "download": self._eager_download(assignments_dev),
                 "req": req,
                 "nzr": nzr,
                 "b": b,
@@ -1382,6 +1506,7 @@ class BatchScheduler(Scheduler):
 
         return {
             "tier": TIER_XLA,  # mesh solves are plain XLA lowerings
+            "download": self._eager_download(assignments_dev),
             # copy: the caller's list is cleared after dispatch returns
             "solver_infos": list(solver_infos),
             "has_required_anti": has_required_anti,
@@ -1401,6 +1526,19 @@ class BatchScheduler(Scheduler):
             "mask_rows": mask_rows,
             "mask_index_solved": midx,
         }
+
+    @staticmethod
+    def _eager_download(assignments_dev):
+        """Start the device->host result copy at dispatch time (host
+        tiers already hand back numpy -- nothing to transfer)."""
+        if isinstance(assignments_dev, np.ndarray):
+            return None
+        if not _EAGER_DOWNLOAD_OK:
+            # a starved host (<=2 cores) has no spare core to run the
+            # copy thread: the overlap becomes pure GIL contention with
+            # the dispatcher/committer (measured ~10% slower end-to-end)
+            return None
+        return _EagerDownload(assignments_dev)
 
     def _mesh_solve(
         self, common_args, spread, affinity, score_batch, padded, nt
@@ -1465,6 +1603,10 @@ class BatchScheduler(Scheduler):
         )
 
         def download():
+            eager = p.get("download")
+            if eager is not None:
+                # copy already in flight since dispatch; await it
+                return eager.result()
             return np.asarray(p["assignments_dev"])
 
         try:
@@ -1578,78 +1720,144 @@ class BatchScheduler(Scheduler):
         bulk_ok = (
             prof.uses_default_binder_only() and self._bind_pool is not None
         )
-        # hoisted out of the per-pod loop: numpy scalar -> int conversion
-        # in one C pass, binder extenders (normally none), and the
-        # relevance tables (empty table => plugins_relevant is False for
-        # every pod, no call needed)
-        order_l = order.tolist()
-        assign_l = assignments.tolist()
+        # hoisted out of the per-pod loop: binder extenders (normally
+        # none) and the relevance tables (empty table =>
+        # plugins_relevant is False for every pod, no call needed)
         binder_extenders = [e for e in extenders if e.is_binder()]
         reserve_maybe = prof.relevance_entries("reserve")
         permit_maybe = prof.relevance_entries("permit")
 
-        plain: List[Tuple[PodInfo, str]] = []  # (pod_info, host)
+        plain_pis: List[PodInfo] = []  # placed pods on the bulk path ...
+        clones: List = []  # ... their assumed clones ...
+        hosts: List[str] = []  # ... and target nodes (parallel lists)
         slow: List[Tuple[PodInfo, int, int]] = []  # (pod_info, choice, k)
-        for k in range(b):
-            pi = solver_infos[order_l[k]]
-            choice = assign_l[k]
-            if gang_failed_uids and pi.pod.metadata.uid in gang_failed_uids:
-                # quorum-masked gang member: no placement, no preemption
-                # (the group chose not to place; a PodGroupMemberAdd
-                # wakeup retries once the group can assemble)
-                metrics.schedule_attempts.inc(result="unschedulable")
-                self.record_scheduling_failure(
-                    prof, pi,
-                    "pod group cannot reach minMember this cycle",
-                    "Unschedulable", "", pod_scheduling_cycle,
-                )
-                self.pods_solved_on_device += 1
-                continue
-            if choice == NO_NODE:
-                slow.append((pi, choice, k))
-                continue
-            pod = pi.pod
-            if (
-                bulk_ok
-                and not (
+
+        # -- fused fast path: when no per-pod gate can fire (default
+        # binder only, no gang masking, no binder extenders, and no
+        # reserve/permit plugin relevant to ANY pod in the batch -- one
+        # any() probe instead of three checks per pod), the whole
+        # classification collapses to numpy: one stable argsort over the
+        # assignment row splits NO_NODE from placed AND groups the
+        # placed slots by target node (the grouped order feeds the
+        # cache's per-node bulk assume), and one native pass
+        # (commit_gather) gathers PodInfos + assumed clones + hosts.
+        fast = bulk_ok and not gang_failed_uids and not binder_extenders
+        if fast and (reserve_maybe or permit_maybe):
+            fast = not any(
+                (
                     reserve_maybe
-                    and prof.plugins_relevant("reserve", pod)
+                    and prof.plugins_relevant("reserve", pi.pod)
                 )
-                and not (
-                    permit_maybe and prof.plugins_relevant("permit", pod)
+                or (
+                    permit_maybe
+                    and prof.plugins_relevant("permit", pi.pod)
                 )
-                and not (
-                    binder_extenders
-                    and any(e.is_interested(pod) for e in binder_extenders)
+                for pi in solver_infos
+            )
+        if fast:
+            with timeline.span("commit.gather"):
+                head = np.asarray(assignments[:b])
+                grp = np.argsort(head, kind="stable")
+                n_unplaced = int((head == NO_NODE).sum())
+                placed = grp[n_unplaced:]
+                order_np = np.asarray(order)
+                order2 = order_np[placed].tolist()
+                assign2 = head[placed].tolist()
+                gather = (
+                    _commit_gather
+                    if _commit_gather is not None
+                    else _commit_gather_py
                 )
-            ):
-                plain.append((pi, names[choice]))
-            else:
-                slow.append((pi, choice, k))
+                plain_pis, clones, hosts = gather(
+                    solver_infos, order2, assign2,
+                    names if isinstance(names, list) else list(names),
+                )
+            if n_unplaced:
+                slow = [
+                    (solver_infos[int(order_np[k])], NO_NODE, k)
+                    for k in grp[:n_unplaced].tolist()
+                ]
+        else:
+            # numpy scalar -> int conversion in one C pass each (only
+            # the per-pod loop reads them)
+            order_l = order.tolist()
+            assign_l = assignments.tolist()
+            plain: List[Tuple[PodInfo, str]] = []  # (pod_info, host)
+            for k in range(b):
+                pi = solver_infos[order_l[k]]
+                choice = assign_l[k]
+                if (
+                    gang_failed_uids
+                    and pi.pod.metadata.uid in gang_failed_uids
+                ):
+                    # quorum-masked gang member: no placement, no
+                    # preemption (the group chose not to place; a
+                    # PodGroupMemberAdd wakeup retries once the group
+                    # can assemble)
+                    metrics.schedule_attempts.inc(result="unschedulable")
+                    self.record_scheduling_failure(
+                        prof, pi,
+                        "pod group cannot reach minMember this cycle",
+                        "Unschedulable", "", pod_scheduling_cycle,
+                    )
+                    self.pods_solved_on_device += 1
+                    continue
+                if choice == NO_NODE:
+                    slow.append((pi, choice, k))
+                    continue
+                pod = pi.pod
+                if (
+                    bulk_ok
+                    and not (
+                        reserve_maybe
+                        and prof.plugins_relevant("reserve", pod)
+                    )
+                    and not (
+                        permit_maybe
+                        and prof.plugins_relevant("permit", pod)
+                    )
+                    and not (
+                        binder_extenders
+                        and any(
+                            e.is_interested(pod) for e in binder_extenders
+                        )
+                    )
+                ):
+                    plain.append((pi, names[choice]))
+                else:
+                    slow.append((pi, choice, k))
+            if plain:
+                with timeline.span("commit.clone"):
+                    if _assume_clones is not None:
+                        clones = _assume_clones(
+                            [pi.pod for pi, _ in plain],
+                            [host for _, host in plain],
+                        )
+                    else:
+                        clones = []
+                        for pi, host in plain:
+                            assumed = pi.pod.assumed_clone()
+                            assumed.spec.node_name = host
+                            clones.append(assumed)
+                plain_pis = [pi for pi, _ in plain]
+                hosts = [host for _, host in plain]
 
         bulk: List[Tuple] = []
         deferred: List[Tuple] = []  # sync-mode Permit waiters
-        if plain:
-            with timeline.span("commit.clone"):
-                if _assume_clones is not None:
-                    clones = _assume_clones(
-                        [pi.pod for pi, _ in plain],
-                        [host for _, host in plain],
-                    )
-                else:
-                    clones = []
-                    for pi, host in plain:
-                        assumed = pi.pod.assumed_clone()
-                        assumed.spec.node_name = host
-                        clones.append(assumed)
+        if plain_pis:
             with timeline.span("commit.assume"):
+                # on the fast path the argsort grouped the clones by
+                # target node, so the cache lands them as per-node runs
+                # (one node lookup + one generation bump per run)
                 errs = self.cache.assume_pods(clones)
             self.queue.delete_nominated_pods_if_exist(clones)
             # CycleState is built lazily in the binding cycle (only
             # pre_bind/unreserve/post_bind plugins and failure paths read
             # it; the plain burst has none)
             if any(errs):
-                for (pi, host), assumed, err in zip(plain, clones, errs):
+                for pi, assumed, host, err in zip(
+                    plain_pis, clones, hosts, errs
+                ):
                     if err is not None:
                         self.record_scheduling_failure(
                             prof, pi, str(err), "SchedulerError", "",
@@ -1658,9 +1866,11 @@ class BatchScheduler(Scheduler):
                         continue
                     bulk.append((prof, None, pi, assumed, host))
             else:
-                for (pi, host), assumed in zip(plain, clones):
-                    bulk.append((prof, None, pi, assumed, host))
-            self.pods_solved_on_device += len(plain)
+                bulk = [
+                    (prof, None, pi, assumed, host)
+                    for pi, assumed, host in zip(plain_pis, clones, hosts)
+                ]
+            self.pods_solved_on_device += len(plain_pis)
 
         failed_group: List[Tuple[PodInfo, FitError]] = []
         cluster_anti = None

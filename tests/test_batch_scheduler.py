@@ -381,3 +381,66 @@ class TestNomineeConstrainedFallback:
         assert any(p.spec.node_name for p in pods)
         assert sched.nominee_constrained_fallbacks >= 1
         assert sched.pods_fallback >= 1
+
+
+class TestEagerDownload:
+    """The dispatch-time result download (PR 4): on this box the core
+    gate may disable it, so these tests force the path on."""
+
+    def test_eager_download_result_roundtrip(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kubernetes_tpu.scheduler.batch import _EagerDownload
+
+        dev = jnp.arange(16, dtype=jnp.int32)
+        dl = _EagerDownload(dev)
+        out = dl.result()
+        assert isinstance(out, np.ndarray)
+        assert out.tolist() == list(range(16))
+        # result() is idempotent
+        assert dl.result() is out
+
+    def test_eager_download_propagates_errors(self):
+        from kubernetes_tpu.scheduler.batch import _EagerDownload
+
+        class Boom:
+            def __array__(self, *a, **k):
+                raise RuntimeError("serving link down")
+
+        dl = _EagerDownload(Boom())
+        with pytest.raises(RuntimeError, match="serving link down"):
+            dl.result()
+
+    def test_pipeline_binds_with_eager_downloads_forced(self, cluster, monkeypatch):
+        """Full dispatch->commit flow with the eager path forced on
+        (regardless of the host-core gate)."""
+        from kubernetes_tpu.scheduler import batch as batch_mod
+
+        monkeypatch.setattr(batch_mod, "_EAGER_DOWNLOAD_OK", True)
+        server, client, informers, sched = cluster
+        for i in range(6):
+            client.create_node(
+                make_node(f"ed-n{i}")
+                .capacity(cpu="8", memory="16Gi", pods=32)
+                .obj()
+            )
+        informers.start()
+        informers.wait_for_cache_sync()
+        for i in range(40):
+            client.create_pod(
+                make_pod(f"ed-p{i}")
+                .container(cpu="100m", memory="128Mi")
+                .obj()
+            )
+        sched.queue.run()
+        deadline = time.time() + 30
+        done = 0
+        while done < 40 and time.time() < deadline:
+            done += sched.schedule_batch(timeout=0.5, pipeline=True)
+        sched._drain_pending()
+        sched.wait_for_inflight_binds(timeout=30)
+        _wait_all_bound(client, 40)
+        # the device path actually ran with eager downloads in flight
+        assert sched.pods_solved_on_device == 40
+        assert sched.pods_fallback == 0

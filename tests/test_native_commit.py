@@ -184,3 +184,62 @@ def test_bind_assumed_bulk_rv_matches_store_counter():
     more = _mk_pods(1, "rr")
     server.create_bulk(more)
     assert more[0].metadata.resource_version == server.current_rv()
+
+
+# -- commit_gather vs the Python fallback ----------------------------------
+
+
+def _gather_inputs(n, nodes, seed=0):
+    import random
+
+    from kubernetes_tpu.framework.interface import PodInfo
+
+    rng = random.Random(seed)
+    infos = [
+        PodInfo(p, float(i)) for i, p in enumerate(_mk_pods(n, "g"))
+    ]
+    names = [f"node-{i}" for i in range(nodes)]
+    order = list(range(n))
+    rng.shuffle(order)
+    assigns = [rng.randrange(nodes) for _ in range(n)]
+    return infos, order, assigns, names
+
+
+def test_commit_gather_matches_python_fallback():
+    from kubernetes_tpu.scheduler.batch import _commit_gather_py
+
+    infos, order, assigns, names = _gather_inputs(32, 7, seed=3)
+    n_pis, n_clones, n_hosts = native.commit_gather(
+        infos, order, assigns, names
+    )
+    p_pis, p_clones, p_hosts = _commit_gather_py(
+        infos, order, assigns, names
+    )
+    assert n_hosts == p_hosts
+    assert [pi.pod.metadata.name for pi in n_pis] == [
+        pi.pod.metadata.name for pi in p_pis
+    ]
+    for nc, pc, host in zip(n_clones, p_clones, n_hosts):
+        assert nc.spec.node_name == host == pc.spec.node_name
+        assert nc.metadata is pc.metadata  # both share the original's
+        # fresh pod + fresh spec, everything else shared (the
+        # assumed_clone sharing contract)
+        assert nc.spec.containers is pc.spec.containers
+        assert nc.status is pc.status
+
+
+def test_commit_gather_leaves_originals_untouched():
+    infos, order, assigns, names = _gather_inputs(8, 3, seed=5)
+    native.commit_gather(infos, order, assigns, names)
+    for pi in infos:
+        assert pi.pod.spec.node_name == ""
+
+
+def test_commit_gather_rejects_out_of_range():
+    infos, order, assigns, names = _gather_inputs(4, 2, seed=1)
+    with pytest.raises(IndexError):
+        native.commit_gather(infos, [0, 1, 99, 3], assigns, names)
+    with pytest.raises(IndexError):
+        native.commit_gather(infos, order, [0, 1, 0, 99], names)
+    with pytest.raises(ValueError):
+        native.commit_gather(infos, order[:2], assigns, names)

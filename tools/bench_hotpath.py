@@ -1,0 +1,170 @@
+"""Host-stage microbenchmarks: queue drain, pack, commit gather/assume.
+
+The end-to-end bench (bench.py) measures the pipeline; this tool
+isolates the three host stages PR 4 vectorized so a regression in any
+one of them is visible WITHOUT the noise of the full burst (informers,
+solver, bind pool). Synthetic input, no scheduler stack, no device work.
+
+Prints ONE JSON line:
+
+  {"pods": N, "nodes": M,
+   "queue_drain_ms":     bulk pop_batch of N queued pods,
+   "queue_drain_perpod_ms": the same drain via per-pod pop() calls,
+   "pack_ms":            pack_pod_batch over the N pods,
+   "commit_gather_ms":   argsort split + native commit_gather,
+   "commit_assume_ms":   node-grouped cache.assume_pods of the clones}
+
+Usage: python tools/bench_hotpath.py [--pods 10000] [--nodes 5000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np  # noqa: E402
+
+
+def _make_queue(pods):
+    from kubernetes_tpu.framework.interface import PodInfo
+    from kubernetes_tpu.plugins.queuesort import PrioritySort
+    from kubernetes_tpu.queue.scheduling_queue import PriorityQueue
+
+    sorter = PrioritySort()
+    q = PriorityQueue(
+        sorter.queue_sort_less, sort_key_func=sorter.queue_sort_key
+    )
+    q.add_many(pods)
+    return q, PodInfo
+
+
+def bench_queue_drain(pods, batch):
+    """One bulk pop_batch over the full backlog vs the same drain
+    through per-pod pop() calls (the pre-PR-4 shape)."""
+    q, _ = _make_queue(pods)
+    t0 = time.perf_counter()
+    got = 0
+    while got < len(pods):
+        out = q.pop_batch(batch, timeout=0.0)
+        if not out:
+            break
+        got += len(out)
+    bulk_ms = (time.perf_counter() - t0) * 1000
+    assert got == len(pods), f"bulk drain lost pods: {got}/{len(pods)}"
+
+    q, _ = _make_queue(pods)
+    t0 = time.perf_counter()
+    got = 0
+    while got < len(pods):
+        if q.pop(timeout=0.0) is None:
+            break
+        got += 1
+    perpod_ms = (time.perf_counter() - t0) * 1000
+    assert got == len(pods), f"per-pod drain lost pods: {got}/{len(pods)}"
+    return bulk_ms, perpod_ms
+
+
+def bench_pack(pods):
+    from kubernetes_tpu.tensors import pack_pod_batch
+    from kubernetes_tpu.tensors.node_tensor import ResourceDims
+
+    dims = ResourceDims()
+    # memoization is part of the measured steady state: first call warms
+    # the per-pod memos exactly like the first burst batch does
+    t0 = time.perf_counter()
+    pack_pod_batch(pods, dims)
+    return (time.perf_counter() - t0) * 1000
+
+
+def bench_commit(pods, node_names):
+    """The fused committer tail on synthetic assignments: stable argsort
+    split, gather + clone (native when available), node-grouped bulk
+    assume into a fresh cache."""
+    from kubernetes_tpu.cache.cache import SchedulerCache
+    from kubernetes_tpu.framework.interface import PodInfo
+    from kubernetes_tpu.scheduler.batch import (
+        _commit_gather_py,
+        NO_NODE,
+    )
+
+    try:
+        from kubernetes_tpu.native import commit_gather
+    except Exception:  # noqa: BLE001
+        commit_gather = None
+    gather = commit_gather or _commit_gather_py
+
+    infos = [PodInfo(p, float(i)) for i, p in enumerate(pods)]
+    b = len(pods)
+    rng = np.random.default_rng(0)
+    assignments = rng.integers(0, len(node_names), size=b).astype(np.int64)
+    assignments[:: max(1, b // 50)] = NO_NODE  # ~2% unplaced
+    order = np.arange(b)
+
+    t0 = time.perf_counter()
+    grp = np.argsort(assignments, kind="stable")
+    n_unplaced = int((assignments == NO_NODE).sum())
+    placed = grp[n_unplaced:]
+    order2 = order[placed].tolist()
+    assign2 = assignments[placed].tolist()
+    pis, clones, hosts = gather(infos, order2, assign2, node_names)
+    gather_ms = (time.perf_counter() - t0) * 1000
+
+    cache = SchedulerCache()
+    t0 = time.perf_counter()
+    errs = cache.assume_pods(clones)
+    assume_ms = (time.perf_counter() - t0) * 1000
+    assert not any(errs), "synthetic assume reported errors"
+    assert len(pis) == b - n_unplaced
+    return gather_ms, assume_ms
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pods", type=int, default=10000)
+    ap.add_argument("--nodes", type=int, default=5000)
+    ap.add_argument(
+        "--batch", type=int, default=4096,
+        help="pop_batch size for the queue drain (bench.py default)",
+    )
+    args = ap.parse_args()
+
+    from kubernetes_tpu.testing import make_pod
+
+    pods = [
+        make_pod(f"hp-{i}")
+        .container(cpu="250m", memory="512Mi")
+        .priority(i % 3)
+        .obj()
+        for i in range(args.pods)
+    ]
+    node_names = [f"node-{i}" for i in range(args.nodes)]
+
+    drain_ms, drain_perpod_ms = bench_queue_drain(pods, args.batch)
+    pack_ms = bench_pack(pods)
+    gather_ms, assume_ms = bench_commit(pods, node_names)
+
+    print(
+        json.dumps(
+            {
+                "metric": "hotpath_microbench",
+                "pods": args.pods,
+                "nodes": args.nodes,
+                "queue_drain_ms": round(drain_ms, 2),
+                "queue_drain_perpod_ms": round(drain_perpod_ms, 2),
+                "pack_ms": round(pack_ms, 2),
+                "commit_gather_ms": round(gather_ms, 2),
+                "commit_assume_ms": round(assume_ms, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
