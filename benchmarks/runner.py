@@ -545,6 +545,12 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
             "pipeline_drains": sched.pipeline_drains,
             "state_reuses": sched.state_reuses,
             "state_uploads": sched.state_uploads,
+            "delta_rows_uploaded": getattr(
+                sched, "delta_rows_uploaded", 0
+            ),
+            "carry_divergences": getattr(
+                sched, "carry_divergences", 0
+            ),
             "gang_resolves": sched.gang_resolves,
         }
         return result

@@ -351,13 +351,22 @@ class SchedulerCache:
             changed = False
             for name, ni in self._nodes.items():
                 if ni.generation > snapshot.generation:
+                    prev = snapshot.node_info_map.get(name)
+                    if prev is None or (prev.node is None) != (
+                        ni.node is None
+                    ):
+                        # a new map entry, or a node-object transition,
+                        # moves node_info_list membership/row identity
+                        snapshot.note_membership_change()
                     snapshot.node_info_map[name] = ni.clone()
+                    snapshot.note_changed(name)
                     changed = True
                     if ni.generation > max_gen:
                         max_gen = ni.generation
             stale = set(snapshot.node_info_map) - set(self._nodes)
             for name in stale:
                 del snapshot.node_info_map[name]
+                snapshot.note_membership_change()
                 changed = True
             if changed:
                 snapshot.refresh_lists()

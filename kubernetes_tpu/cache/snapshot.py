@@ -9,10 +9,20 @@ packed tensor view consumed by the TPU solver
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Optional
+import threading
+from bisect import bisect_right
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from kubernetes_tpu.api.types import Node, Pod
 from kubernetes_tpu.cache.node_info import NodeInfo, pod_has_affinity_constraints
+
+#: above this many accumulated changed names the per-name tracking stops
+#: paying for itself -- consumers fall back to the full generation walk
+CHANGE_TRACK_CAP = 4096
+
+
+def _entry_seq(entry: Tuple[int, str]) -> int:
+    return entry[0]
 
 
 class Snapshot:
@@ -26,6 +36,22 @@ class Snapshot:
             ni for ni in self.node_info_list if ni.pods_with_affinity
         ]
         self.generation: int = 0
+        # -- change tracking (epoch plumbing for the tensor packer) ---------
+        # update_snapshot notes every name it re-clones in an APPEND-ONLY
+        # sequence-stamped log so any NodeTensorCache can repack O(changed)
+        # rows without walking all N NodeInfos per dispatch. Reads are
+        # cursor-based and never mutate the log: the scheduler's cache,
+        # the preemptor's sibling cache, and the prewarm thread's fresh
+        # cache all share this snapshot, so a one-shot consume would let
+        # one consumer steal another's notes (silently stale rows).
+        self._change_lock = threading.Lock()
+        self._change_log: List[Tuple[int, str]] = []
+        self._change_seq = 0
+        # seqs <= _dropped_seq may be missing from the log (cap overflow):
+        # a cursor behind it must take the full generation walk
+        self._dropped_seq = 0
+        # seq of the last membership / ordering change
+        self._membership_seq = 0
 
     # SharedLister surface ---------------------------------------------------
 
@@ -41,10 +67,66 @@ class Snapshot:
     def num_nodes(self) -> int:
         return len(self.node_info_list)
 
+    # -- change tracking -----------------------------------------------------
+
+    def note_changed(self, name: str) -> None:
+        """update_snapshot re-cloned this node's NodeInfo."""
+        with self._change_lock:
+            self._change_seq += 1
+            self._change_log.append((self._change_seq, name))
+            if len(self._change_log) > CHANGE_TRACK_CAP:
+                # tracking stopped paying for itself: drop the log and
+                # send every cursor behind this point to the full walk
+                self._dropped_seq = self._change_seq
+                self._change_log.clear()
+
+    def note_membership_change(self) -> None:
+        """A node appeared in / disappeared from the map (or lost its
+        Node object): row identity may have moved."""
+        with self._change_lock:
+            self._change_seq += 1
+            self._membership_seq = self._change_seq
+
+    def change_cursor(self) -> int:
+        """Current change-log position: the baseline for a NEW consumer
+        (which must full-walk once, then read ``changes_since`` from
+        here)."""
+        with self._change_lock:
+            return self._change_seq
+
+    def changes_since(
+        self, cursor: int
+    ) -> Tuple[Optional[Set[str]], bool, int]:
+        """Read-only cursor advance over the change log:
+        ``(changed_names_or_None, membership_moved, new_cursor)``.
+        ``None`` names mean the log was truncated past ``cursor`` (cap
+        overflow) and the caller must fall back to the full generation
+        walk. Never mutates the log, so any number of NodeTensorCache
+        consumers can share one snapshot without stealing each other's
+        notes."""
+        with self._change_lock:
+            membership_moved = self._membership_seq > cursor
+            if cursor < self._dropped_seq:
+                return None, membership_moved, self._change_seq
+            # the log is seq-sorted (append-only, monotonic): bisect to
+            # the cursor instead of rescanning all (up to cap) entries
+            i = bisect_right(self._change_log, cursor, key=_entry_seq)
+            names = {n for _s, n in self._change_log[i:]}
+            return names, membership_moved, self._change_seq
+
     def refresh_lists(self) -> None:
+        old = self.node_info_list
         self.node_info_list = [
             ni for ni in self.node_info_map.values() if ni.node is not None
         ]
+        # any change to the NAME SEQUENCE (add/remove/reorder) moves row
+        # identity for the tensor packer -- flag it so the change-tracked
+        # fast path never packs against a stale row layout
+        if len(old) != len(self.node_info_list) or any(
+            a.node_name != b.node_name
+            for a, b in zip(old, self.node_info_list)
+        ):
+            self.note_membership_change()
         self.have_pods_with_affinity_list = [
             ni for ni in self.node_info_list if ni.pods_with_affinity
         ]

@@ -396,6 +396,17 @@ def _solve_packed_jit(
     valid = arrs["valid"].astype(bool) if "valid" in arrs else valid_in
     req_state = arrs["req_state"] if "req_state" in arrs else req_in
     nzr_state = arrs["nzr_state"] if "nzr_state" in arrs else nzr_in
+    # row-delta scatter (the steady-state patch path): changed node rows
+    # ride the same single upload buffer as (indices, rows) and are
+    # scattered onto the device-RESIDENT state here, so external churn
+    # costs O(changed rows) on the serving link instead of a full [N, R]
+    # re-upload. Padding slots carry index >= N and drop.
+    if "didx" in arrs:
+        didx = arrs["didx"]
+        req_state = req_state.at[didx].set(arrs["dreq"], mode="drop")
+        nzr_state = nzr_state.at[didx].set(arrs["dnzr"], mode="drop")
+    if "sidx" in arrs:
+        alloc = alloc.at[arrs["sidx"]].set(arrs["salloc"], mode="drop")
     pod_req = arrs["req"]
     pod_nzr_ = arrs["nzr"]
     midx = arrs["midx"]
@@ -436,6 +447,31 @@ def _solve_packed_jit(
         active, config=config,
     )
     return assignment, req_out, nzr_out, alloc, valid
+
+
+@jax.jit
+def apply_assignment_delta(
+    req_state: jnp.ndarray,  # [N, R] int32 device-resident
+    nzr_state: jnp.ndarray,  # [N, 2] int32 device-resident
+    assignments: jnp.ndarray,  # [B] int32 node index or NO_NODE
+    pod_req: jnp.ndarray,  # [B, R] int32, solve order
+    pod_nzr: jnp.ndarray,  # [B, 2] int32, solve order
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter-add one solve's own assignment output onto the
+    device-resident node state: every placed pod's request row lands on
+    its chosen node row; NO_NODE / inactive-padding slots drop. JAX
+    WRAPS negative indices even under ``mode="drop"``, so NO_NODE (-1)
+    must be remapped to an out-of-bounds index first or every unplaced
+    slot would land on the last node row. The device-tier scans apply
+    this inside their own carry; this standalone jit keeps the carry
+    warm when the assignments were produced OFF device (the host-greedy
+    ladder tier), at an O(B*R) upload instead of a full [N, R]
+    re-upload next dispatch."""
+    idx = jnp.where(assignments < 0, req_state.shape[0], assignments)
+    return (
+        req_state.at[idx].add(pod_req, mode="drop"),
+        nzr_state.at[idx].add(pod_nzr, mode="drop"),
+    )
 
 
 class ConstPiece:

@@ -52,6 +52,7 @@ from kubernetes_tpu.framework.interface import (
 from kubernetes_tpu.ops.assignment import (
     GreedyConfig,
     NO_NODE,
+    apply_assignment_delta,
     greedy_assign_compact,
     greedy_assign_constrained,
     sinkhorn_assign,
@@ -201,41 +202,102 @@ def solver_supported(pod: Pod) -> bool:
     return not solver_unsupported_reason(pod)
 
 
+#: padded row count of the (indices, rows) delta-scatter slot riding the
+#: steady-state upload buffer: one fixed bucket keeps the steady solve at
+#: ONE jit signature regardless of churn; more than this many changed
+#: rows per dispatch escalates to a (counted) full upload
+DELTA_ROW_BUCKET = 64
+#: per-batch expected-delta ring bound: the host can trail the device by
+#: at most the in-flight batches plus the mirror/assume window. Overflow
+#: drops the oldest delta, which at worst turns a later handshake into a
+#: counted divergence (full upload) -- never a silent mismatch.
+_SHADOW_RING_CAP = MAX_INFLIGHT + 2
+
+
+def _delta_slot_pieces(
+    n_cap, r_dims, fix_rows=None, alloc_rows=None,
+    node_requested=None, node_nzr=None, allocatable=None,
+):
+    """The fixed `DELTA_ROW_BUCKET`-sized (indices, rows) scatter slots
+    every steady-state dispatch carries in the single upload buffer.
+    Shapes/dtypes/padding here ARE the jit signature the warmup
+    precompiles -- the dispatch path and `_maybe_warm` must build them
+    through this one helper or they fork a second signature and the
+    first production batch pays the compile the warmup was built to
+    prevent. Empty slots carry index ``n_cap`` (out of bounds) and drop
+    on device."""
+    didx = np.full(DELTA_ROW_BUCKET, n_cap, dtype=np.int32)
+    dreq = np.zeros((DELTA_ROW_BUCKET, r_dims), dtype=np.int32)
+    dnzr = np.zeros((DELTA_ROW_BUCKET, 2), dtype=np.int32)
+    sidx = np.full(DELTA_ROW_BUCKET, n_cap, dtype=np.int32)
+    salloc = np.zeros((DELTA_ROW_BUCKET, r_dims), dtype=np.int32)
+    if fix_rows is not None and fix_rows.size:
+        didx[: fix_rows.size] = fix_rows
+        dreq[: fix_rows.size] = node_requested[fix_rows]
+        dnzr[: fix_rows.size] = node_nzr[fix_rows]
+    if alloc_rows is not None and alloc_rows.size:
+        sidx[: alloc_rows.size] = alloc_rows
+        salloc[: alloc_rows.size] = allocatable[alloc_rows]
+    return [
+        ("didx", didx), ("dreq", dreq), ("dnzr", dnzr),
+        ("sidx", sidx), ("salloc", salloc),
+    ]
+
+
 class _DeviceNodeState:
-    """Device-resident node tensors + host shadows.
+    """Device-resident node tensors + the generation-handshake
+    bookkeeping that validates their reuse.
 
     Every host->device transfer over the serving link pays a round trip
     (SURVEY.md section 7 "hardest parts (e)"), so the solver keeps node
     state ON DEVICE between batches: the scan already returns the
-    post-batch (requested, nzr), and the host mirrors the same integer
-    updates into ``*_shadow``. Next batch, if the freshly packed host
-    tensors equal the shadows (nothing but our own placements landed),
-    the carried device buffers are reused and NOTHING node-sized is
-    uploaded -- the device analogue of cache.UpdateSnapshot's
-    generation-compare incrementalism (cache.go:239)."""
+    post-batch (requested, nzr) on device, and the host mirrors the same
+    integer updates into ``req_shadow``/``nzr_shadow`` at commit time.
+
+    Reuse validation is a GENERATION HANDSHAKE, not an array sweep: the
+    NodeTensorCache stamps every repacked row with a monotonic epoch, so
+    at dispatch only ``rows_changed_since(validated_epoch)`` need a
+    content compare against the expectation -- O(changed rows), while the
+    old design re-swept the full [N, R] arrays against every shadow
+    generation. The committer may trail the dispatcher by several
+    batches; ``pending_deltas`` holds each mirrored batch's per-row adds
+    so a host state that trails the shadow by a suffix of them still
+    validates. Changed rows the expectation does NOT explain (node churn,
+    bind failures) are divergences: they are scatter-patched onto the
+    resident state as (indices, rows) -- or, with work in flight or too
+    many rows, resolved by a counted full upload. Never silently wrong.
+    """
 
     def __init__(self) -> None:
         self.alloc_dev = None
         self.valid_dev = None
-        self.alloc_shadow: Optional[np.ndarray] = None
-        self.valid_shadow: Optional[np.ndarray] = None
         self.req_dev = None
         self.nzr_dev = None
-        # expected host states as COMPLETED batches' commits land: a ring
-        # of (requested, nzr) shadow generations, newest last. With the
-        # async committer the host may trail the device by up to
-        # MAX_INFLIGHT completed-but-uncommitted batches when the
-        # dispatcher packs; matching ANY generation in the ring means the
-        # device carry is ahead of the host by exactly the newer mirrors,
-        # which is the pipelined steady state, not divergence.
-        self.shadow_gens: "collections.deque" = collections.deque(
-            maxlen=MAX_INFLIGHT + 1
+        # -- handshake bookkeeping ---------------------------------------
+        # the NodeTensorCache layout epoch the device buffers were built
+        # against: row identity is only comparable while it stands
+        self.layout_epoch = -1
+        # the cache update epoch the shadows were last reconciled to
+        self.validated_epoch = -1
+        # expected host state: alloc mirrors the packed allocatable
+        # (patched row-wise); req/nzr mirror the packed requested state
+        # plus every mirrored (committed) batch
+        self.alloc_shadow: Optional[np.ndarray] = None
+        self.req_shadow: Optional[np.ndarray] = None
+        self.nzr_shadow: Optional[np.ndarray] = None
+        # per-batch expected row deltas the host pack may not have shown
+        # yet: (node_rows [K], req_rows [K, R], nzr_rows [K, 2]), newest
+        # last (replaces the retired full-array shadow_gens ring)
+        self.pending_deltas: "collections.deque" = collections.deque(
+            maxlen=_SHADOW_RING_CAP
         )
 
     def invalidate_carry(self) -> None:
         self.req_dev = None
         self.nzr_dev = None
-        self.shadow_gens.clear()
+        self.req_shadow = None
+        self.nzr_shadow = None
+        self.pending_deltas.clear()
 
 
 class BatchScheduler(Scheduler):
@@ -288,6 +350,12 @@ class BatchScheduler(Scheduler):
         self.nominee_constrained_fallbacks = 0  # nominees + constraints
         self.state_reuses = 0
         self.state_uploads = 0
+        # generation-handshake visibility: total changed node rows shipped
+        # as (indices, rows) scatters instead of full [N, R] uploads, and
+        # handshake mismatches (host state not explained by our own
+        # mirrored placements -- node churn, bind failures)
+        self.delta_rows_uploaded = 0
+        self.carry_divergences = 0
         self._dev = _DeviceNodeState()
         self._shadow_lock = threading.Lock()
         # pipelined batches flow dispatcher -> committer through this
@@ -490,8 +558,7 @@ class BatchScheduler(Scheduler):
                 return pending
             inactive |= failed
             self.gang_resolves += 1
-            with self._shadow_lock:
-                self._dev.invalidate_carry()
+            self._rewind_carry(pending)
             pending = self._dispatch_solve(
                 solver_infos, pending["cycle"], inactive_uids=inactive
             )
@@ -508,6 +575,20 @@ class BatchScheduler(Scheduler):
                 self._dev.invalidate_carry()
         pending["gang_failed_uids"] = inactive
         return pending
+
+    def _rewind_carry(self, pending) -> None:
+        """Rewind the device carry to the given batch's pre-solve state:
+        the gang quorum fixup re-solves the same batch, which must not
+        see the first attempt's reservations. When the dispatch reused
+        the carry, its pre-solve device refs are still alive
+        (``carry_in``) and the rewind costs nothing on the serving link;
+        otherwise the carry drops and the re-dispatch re-uploads."""
+        ci = pending.get("carry_in")
+        with self._shadow_lock:
+            if ci is not None and self._dev.req_dev is not None:
+                self._dev.req_dev, self._dev.nzr_dev = ci
+            else:
+                self._dev.invalidate_carry()
 
     def _pending_assignments(self, p):
         """The batch's downloaded assignments for the gang fixup: await
@@ -856,6 +937,184 @@ class BatchScheduler(Scheduler):
             while self._pending_q:
                 self._pending_cv.wait()
 
+    # -- device-state generation handshake ----------------------------------
+
+    def _explain_rows(self, changed, host_req, host_nzr):
+        """Under ``_shadow_lock``: is every changed row's host content
+        explained by the shadow expectation at some committer-trail
+        depth? The host may trail the shadow by a suffix of
+        ``pending_deltas`` (batches mirrored but whose cache assume the
+        host pack predates) -- peel them newest-first until the changed
+        rows match. Returns ``(ok, divergent_rows, keep)``: on a match
+        ``keep`` is the number of newest deltas still unconfirmed; on a
+        mismatch ``divergent_rows`` holds the depth-0 mismatches and
+        ``keep`` is 0 when NO pending delta touches them (the mismatch
+        is genuinely external, so a row scatter-fix is exact -- the
+        device carry always equals the shadow once every dispatched
+        batch has mirrored) or None when one does (the row may merely
+        be host-lagging; only a full resync is safe)."""
+        ds = self._dev
+        if changed.size == 0:
+            # no repacked rows: nothing to confirm, keep every delta
+            return True, None, len(ds.pending_deltas)
+        exp_req = ds.req_shadow[changed]
+        exp_nzr = ds.nzr_shadow[changed]
+        h_req = host_req[changed]
+        h_nzr = host_nzr[changed]
+        row_ok = np.all(exp_req == h_req, axis=1) & np.all(
+            exp_nzr == h_nzr, axis=1
+        )
+        if row_ok.all():
+            return True, None, 0
+        div_rows = changed[~row_ok]
+        pos = {int(r): j for j, r in enumerate(changed)}
+        keep = 0
+        for rows, req_rows, nzr_rows in reversed(ds.pending_deltas):
+            keep += 1
+            for j, r in enumerate(rows.tolist()):
+                jj = pos.get(int(r))
+                if jj is not None:
+                    exp_req[jj] -= req_rows[j]
+                    exp_nzr[jj] -= nzr_rows[j]
+            if (
+                np.all(exp_req == h_req, axis=1)
+                & np.all(exp_nzr == h_nzr, axis=1)
+            ).all():
+                return True, None, keep
+        div_set = set(div_rows.tolist())
+        lagging = any(
+            int(r) in div_set
+            for rows, _req_rows, _nzr_rows in ds.pending_deltas
+            for r in rows
+        )
+        return False, div_rows, (None if lagging else 0)
+
+    def _negotiate_device_state(
+        self, nt, node_requested, node_nzr, overlaid,
+        allow_scatter, pending_exists,
+    ):
+        """Decide how this dispatch's node state reaches the device and
+        reconcile the handshake bookkeeping. Returns None when in-flight
+        batches block the decision (caller drains and redispatches), else
+        ``{"static_ok", "carry_ok", "didx", "sidx"}``:
+
+        - carry_ok + empty deltas: pure reuse, nothing node-sized rides
+          the link.
+        - carry_ok + didx/sidx rows: reuse, with externally changed rows
+          (divergences / allocatable updates) patched onto the resident
+          state by the in-buffer scatter (ops/assignment.py).
+        - not carry_ok: full [N, R] requested upload (``state_uploads``);
+          not static_ok additionally re-uploads allocatable+valid. The
+          mesh path passes ``allow_scatter=False`` and always resolves
+          changes this way (explicit counted full-upload fallback).
+        """
+        ds = self._dev
+        d = nt.delta
+        empty = np.zeros(0, dtype=np.int64)
+        with self._shadow_lock:
+            layout_ok = (
+                d is not None
+                and ds.alloc_dev is not None
+                and ds.alloc_shadow is not None
+                and ds.layout_epoch == d.layout_epoch
+                and ds.alloc_shadow.shape == nt.allocatable.shape
+            )
+            alloc_rows = empty
+            carry = "dead"
+            div_rows = None
+            keep = 0
+            if layout_ok:
+                changed = self.tensor_cache.rows_changed_since(
+                    ds.validated_epoch
+                )
+                if changed.size:
+                    diff = ~np.all(
+                        nt.allocatable[changed]
+                        == ds.alloc_shadow[changed],
+                        axis=1,
+                    )
+                    alloc_rows = changed[diff]
+                if (
+                    not overlaid
+                    and ds.req_dev is not None
+                    and ds.req_shadow is not None
+                ):
+                    ok, div_rows, keep = self._explain_rows(
+                        changed, node_requested, node_nzr
+                    )
+                    carry = "reuse" if ok else "diverged"
+            static_full = (
+                not layout_ok
+                or alloc_rows.size > DELTA_ROW_BUCKET
+                or (alloc_rows.size > 0 and not allow_scatter)
+            )
+            fix_rows = empty
+            diverged = carry == "diverged"
+            if diverged:
+                if (
+                    allow_scatter
+                    and not static_full
+                    and div_rows.size <= DELTA_ROW_BUCKET
+                    and keep == 0  # no pending delta touches a div row
+                    and not pending_exists
+                ):
+                    # resolvable in place: with nothing in flight the
+                    # carry equals the shadow, so setting the divergent
+                    # rows to host truth on device is exact
+                    fix_rows = div_rows
+                else:
+                    carry = "dead"  # resolve by full upload (or drain)
+            reusable = not static_full and (
+                carry == "reuse" or fix_rows.size > 0
+            )
+            if pending_exists and not reusable:
+                # the device carry is ahead of the host by the in-flight
+                # placements; uploading host state now would re-place
+                # them. Land everything first, then redo the dispatch.
+                return None
+            if reusable:
+                # the fix path requires an empty ring, so keep is only
+                # meaningful (a match depth) on the pure-reuse path
+                for _ in range(len(ds.pending_deltas) - (keep or 0)):
+                    ds.pending_deltas.popleft()
+                if alloc_rows.size:
+                    ds.alloc_shadow[alloc_rows] = nt.allocatable[alloc_rows]
+                if fix_rows.size:
+                    ds.req_shadow[fix_rows] = node_requested[fix_rows]
+                    ds.nzr_shadow[fix_rows] = node_nzr[fix_rows]
+                    self.carry_divergences += 1
+                ds.validated_epoch = d.epoch
+                self.state_reuses += 1
+                self.delta_rows_uploaded += int(
+                    alloc_rows.size + fix_rows.size
+                )
+                return {
+                    "static_ok": True,
+                    "carry_ok": True,
+                    "didx": fix_rows,
+                    "sidx": alloc_rows,
+                }
+            # upload path
+            if diverged:
+                self.carry_divergences += 1
+            static_ok = not static_full and alloc_rows.size == 0
+            if not static_ok:
+                ds.layout_epoch = (
+                    d.layout_epoch if d is not None else -1
+                )
+                ds.alloc_shadow = nt.allocatable.copy()
+            ds.req_shadow = node_requested.copy()
+            ds.nzr_shadow = node_nzr.copy()
+            ds.pending_deltas.clear()
+            ds.validated_epoch = d.epoch if d is not None else -1
+            self.state_uploads += 1
+            return {
+                "static_ok": static_ok,
+                "carry_ok": False,
+                "didx": empty,
+                "sidx": empty,
+            }
+
     def _dispatch_solve(
         self,
         solver_infos: List[PodInfo],
@@ -1192,39 +1451,6 @@ class BatchScheduler(Scheduler):
             ).any():
                 self.preemptor.prewarm_pack_async()
 
-        # -- device-state reuse (see _DeviceNodeState) ----------------------
-        ds = self._dev
-        with self._shadow_lock:
-            static_ok = (
-                ds.alloc_dev is not None
-                and ds.alloc_shadow is not None
-                and ds.alloc_shadow.shape == nt.allocatable.shape
-                and np.array_equal(ds.alloc_shadow, nt.allocatable)
-                and np.array_equal(ds.valid_shadow, nt.valid)
-            )
-
-            # matching any shadow generation is valid: the committer has
-            # mirrored batches the host hasn't committed yet; the device
-            # carry is ahead by exactly those (newest generations first --
-            # the steady state is "caught up or one behind")
-            carry_ok = (
-                static_ok
-                and not overlaid
-                and ds.req_dev is not None
-                and any(
-                    req_s.shape == node_requested.shape
-                    and np.array_equal(req_s, node_requested)
-                    and np.array_equal(nzr_s, node_nzr)
-                    for req_s, nzr_s in reversed(ds.shadow_gens)
-                )
-            )
-        if not carry_ok and self._pending_exists():
-            # host diverged under an in-flight batch (node churn, bind
-            # failure): land it, then redo this dispatch from the fresh
-            # host state
-            self._drain_pending()
-            return self._dispatch_solve(solver_infos, pod_scheduling_cycle)
-
         constrained = (
             spread is not None
             or affinity is not None
@@ -1237,6 +1463,29 @@ class BatchScheduler(Scheduler):
                 self.pods_fallback += 1
                 self.attempt_schedule(pi)
             return None
+
+        # -- device-state generation handshake (see _DeviceNodeState) -------
+        # Runs after every route-to-host bail-out above: it reconciles the
+        # shadow bookkeeping on the assumption that the decided upload /
+        # scatter actually reaches the device this dispatch.
+        ds = self._dev
+        neg = self._negotiate_device_state(
+            nt, node_requested, node_nzr, overlaid,
+            allow_scatter=self.mesh is None,
+            pending_exists=self._pending_exists(),
+        )
+        if neg is None:
+            # the handshake needs an upload but the device carry is ahead
+            # of the host by the in-flight batches (node churn, bind
+            # failure, dead carry): land them, then redo this dispatch
+            # from the fresh host state
+            self._drain_pending()
+            return self._dispatch_solve(
+                solver_infos, pod_scheduling_cycle,
+                inactive_uids=inactive_uids,
+            )
+        static_ok = neg["static_ok"]
+        carry_ok = neg["carry_ok"]
         if self.mesh is None:
             # single-buffer upload: over the serving link every device_put
             # operand pays its own round trip (~40-90ms each); the whole
@@ -1258,14 +1507,15 @@ class BatchScheduler(Scheduler):
             if not carry_ok:
                 pieces.append(("req_state", node_requested))
                 pieces.append(("nzr_state", node_nzr))
-                with self._shadow_lock:
-                    ds.shadow_gens.clear()
-                    ds.shadow_gens.append(
-                        (node_requested.copy(), node_nzr.copy())
-                    )
-                self.state_uploads += 1
             else:
-                self.state_reuses += 1
+                # steady state: the resident [N, R] tensors stay on
+                # device; only the changed-row scatter rides the buffer
+                pieces += _delta_slot_pieces(
+                    nt.capacity, nt.dims.num_dims,
+                    fix_rows=neg["didx"], alloc_rows=neg["sidx"],
+                    node_requested=node_requested, node_nzr=node_nzr,
+                    allocatable=nt.allocatable,
+                )
             if constrained:
                 from kubernetes_tpu.ops.assignment import ConstPiece
 
@@ -1347,6 +1597,15 @@ class BatchScheduler(Scheduler):
             # and redispatches from fresh host state instead)
             if not constrained and not self._pending_exists():
                 attempts.append((TIER_HOST_GREEDY, run_host_greedy))
+            # pre-solve carry refs: the gang quorum fixup restores these
+            # to rewind a re-solved batch to its pre-batch device state
+            # without a re-upload (only exact when no row fixes rode
+            # this dispatch)
+            carry_in = (
+                (ds.req_dev, ds.nzr_dev)
+                if carry_ok and not neg["didx"].size
+                else None
+            )
             try:
                 t_solve = time.perf_counter()
                 with timeline.span("solve_dispatch"):
@@ -1359,6 +1618,28 @@ class BatchScheduler(Scheduler):
             except LadderExhausted:
                 with self._shadow_lock:
                     ds.invalidate_carry()
+                    # no jitted solve LANDED, so the booked upload /
+                    # scatter never became device state: un-book the
+                    # counters (a drain-and-redispatch would book the
+                    # batch again). A device tier that uploaded and then
+                    # failed still paid the link traffic; that cost is
+                    # attributed by solves_by_tier/breaker metrics, not
+                    # here -- state_uploads counts established state.
+                    if carry_ok:
+                        self.state_reuses -= 1
+                        self.delta_rows_uploaded -= int(
+                            neg["didx"].size + neg["sidx"].size
+                        )
+                    else:
+                        self.state_uploads -= 1
+                    if neg["sidx"].size or not static_ok:
+                        # the alloc row patch / full static upload never
+                        # reached the device (no solve ran) but the
+                        # shadow already claims it: drop the resident
+                        # alloc so the next dispatch re-uploads instead
+                        # of trusting it
+                        ds.alloc_dev = None
+                        ds.valid_dev = None
                 if self._pending_exists():
                     # in-flight batches blocked the host tier: land them
                     # (the committer's own recovery handles their
@@ -1384,16 +1665,49 @@ class BatchScheduler(Scheduler):
                 return None
             assignments_dev, req_out, nzr_out, alloc_out, valid_out = out
             if tier == TIER_HOST_GREEDY:
-                # the host tier solved from host state: the device carry
-                # (and any pre-solve shadow bookkeeping above) no longer
-                # describes device-resident reality
+                # the host tier solved from host state and no jitted
+                # solve ran: undo any bookkeeping that assumed the
+                # device saw this dispatch (incl. the link-traffic
+                # counters -- no upload / row scatter actually happened)
                 with self._shadow_lock:
-                    ds.invalidate_carry()
+                    if carry_ok:
+                        self.delta_rows_uploaded -= int(
+                            neg["didx"].size + neg["sidx"].size
+                        )
+                    else:
+                        self.state_uploads -= 1
+                    if neg["sidx"].size or not static_ok:
+                        # alloc patch / full static upload never landed
+                        ds.alloc_dev = None
+                        ds.valid_dev = None
+                    if (
+                        carry_ok
+                        and not neg["didx"].size
+                        and not overlaid
+                        and ds.req_dev is not None
+                    ):
+                        # the host tier was only offered with nothing in
+                        # flight and a validated carry, so its input
+                        # state EQUALS the device carry: scatter-add its
+                        # own assignment output onto the resident state
+                        # (ops/assignment.apply_assignment_delta) and
+                        # keep the carry warm instead of dropping it
+                        ds.req_dev, ds.nzr_dev = apply_assignment_delta(
+                            ds.req_dev, ds.nzr_dev,
+                            np.asarray(
+                                assignments_dev, dtype=np.int32
+                            ),
+                            req, nzr,
+                        )
+                    else:
+                        ds.invalidate_carry()
             else:
                 if not static_ok:
                     ds.alloc_dev, ds.valid_dev = alloc_out, valid_out
-                    ds.alloc_shadow = nt.allocatable.copy()
-                    ds.valid_shadow = nt.valid.copy()
+                elif neg["sidx"].size:
+                    # the in-buffer scatter patched the resident alloc;
+                    # keep the patched ref
+                    ds.alloc_dev = alloc_out
                 try:
                     assignments_dev.copy_to_host_async()
                 except AttributeError:
@@ -1404,6 +1718,7 @@ class BatchScheduler(Scheduler):
                     ds.req_dev, ds.nzr_dev = req_out, nzr_out
             return {
                 "tier": tier,
+                "carry_in": carry_in,
                 "solver_infos": list(solver_infos),
                 "has_required_anti": has_required_anti,
                 "has_ports": batch_ports,
@@ -1450,19 +1765,13 @@ class BatchScheduler(Scheduler):
         )
         if not static_ok:
             ds.alloc_dev, ds.valid_dev = next(it), next(it)
-            ds.alloc_shadow = nt.allocatable.copy()
-            ds.valid_shadow = nt.valid.copy()
-            ds.invalidate_carry()
         if not carry_ok:
+            # shadow bookkeeping already reconciled by the handshake
+            # (_negotiate_device_state); the mesh path has no row-scatter
+            # variant, so every change resolves as a counted full upload
             req_state_d, nzr_state_d = next(it), next(it)
-            # shadow := host state all outstanding work is relative to
-            with self._shadow_lock:
-                ds.shadow_gens.clear()
-                ds.shadow_gens.append((node_requested.copy(), node_nzr.copy()))
-            self.state_uploads += 1
         else:
             req_state_d, nzr_state_d = ds.req_dev, ds.nzr_dev
-            self.state_reuses += 1
 
         common_args = (
             ds.alloc_dev, req_state_d, nzr_state_d, ds.valid_dev,
@@ -1506,6 +1815,9 @@ class BatchScheduler(Scheduler):
 
         return {
             "tier": TIER_XLA,  # mesh solves are plain XLA lowerings
+            "carry_in": (
+                (req_state_d, nzr_state_d) if carry_ok else None
+            ),
             "download": self._eager_download(assignments_dev),
             # copy: the caller's list is cleared after dispatch returns
             "solver_infos": list(solver_infos),
@@ -1651,18 +1963,23 @@ class BatchScheduler(Scheduler):
         metrics.batch_size.observe(b)
         ds = self._dev
         with self._shadow_lock:
-            if not p["overlaid"] and ds.shadow_gens:
+            if not p["overlaid"] and ds.req_shadow is not None:
+                # mirror the batch's own placements into the running
+                # expectation (same int32 arithmetic as the scan carry)
+                # and remember the per-row delta: the dispatcher's
+                # handshake subtracts it while the host cache still
+                # trails this commit. O(B*R) in-place -- the retired
+                # shadow_gens ring copied the full [N, R] per batch.
                 placed = assignments[:b] != NO_NODE
-                rows_placed = assignments[:b][placed]
-                # append a new generation; older ones stay matchable until
-                # the ring rotates them out (host may trail by several
-                # uncommitted batches)
-                req_s, nzr_s = ds.shadow_gens[-1]
-                req_s = req_s.copy()
-                nzr_s = nzr_s.copy()
-                np.add.at(req_s, rows_placed, p["req"][:b][placed])
-                np.add.at(nzr_s, rows_placed, p["nzr"][:b][placed])
-                ds.shadow_gens.append((req_s, nzr_s))
+                if placed.any():
+                    rows_placed = assignments[:b][placed].astype(np.int64)
+                    req_rows = p["req"][:b][placed]
+                    nzr_rows = p["nzr"][:b][placed]
+                    np.add.at(ds.req_shadow, rows_placed, req_rows)
+                    np.add.at(ds.nzr_shadow, rows_placed, nzr_rows)
+                    ds.pending_deltas.append(
+                        (rows_placed, req_rows, nzr_rows)
+                    )
         t_commit = time.perf_counter()
         with timeline.span("commit_batch"):
             self._commit_batch(
@@ -2378,6 +2695,10 @@ class BatchScheduler(Scheduler):
                 ("req_state", np.zeros((n, r), dtype=np.int32)),
                 ("nzr_state", np.zeros((n, 2), dtype=np.int32)),
             ]
+            # steady-state dispatches always carry the (indices, rows)
+            # delta-scatter slots (empty slots drop on device), so the
+            # run loop hits exactly ONE steady signature per mode
+            delta_slots = _delta_slot_pieces(n, r)
             cold = solve_packed(
                 base + static_pieces + carry_pieces, None, None, None, None,
                 config=self.solver_config, mode=self.solver_mode,
@@ -2391,7 +2712,7 @@ class BatchScheduler(Scheduler):
             jax.block_until_ready(refresh)
             _, req_d, nzr_d, _, _ = refresh
             steady = solve_packed(
-                base, alloc_d, valid_d, req_d, nzr_d,
+                base + delta_slots, alloc_d, valid_d, req_d, nzr_d,
                 config=self.solver_config, mode=self.solver_mode,
             )
             jax.block_until_ready(steady)
@@ -2431,7 +2752,7 @@ class BatchScheduler(Scheduler):
             )
             jax.block_until_ready(c_refresh)
             c_steady = solve_packed(
-                base + fam, alloc_d, valid_d, req_d, nzr_d,
+                base + delta_slots + fam, alloc_d, valid_d, req_d, nzr_d,
                 config=self.solver_config, mode="constrained",
             )
             jax.block_until_ready(c_steady)
@@ -2460,7 +2781,8 @@ class BatchScheduler(Scheduler):
                             )
                         )
                 out_one = solve_packed(
-                    base + fam_one, alloc_d, valid_d, req_d, nzr_d,
+                    base + delta_slots + fam_one, alloc_d, valid_d,
+                    req_d, nzr_d,
                     config=self.solver_config, mode="constrained",
                 )
                 jax.block_until_ready(out_one)

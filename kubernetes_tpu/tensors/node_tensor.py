@@ -192,6 +192,25 @@ class ResourceDims:
 
 
 @dataclass
+class TensorDelta:
+    """What one ``NodeTensorCache.update`` actually changed, so callers
+    can reconcile device-resident state in O(changed rows) instead of
+    re-diffing the full ``[N, R]`` arrays.
+
+    ``epoch`` is the cache's monotonic update counter after this update;
+    every row repacked here carries it in the per-row epoch array (see
+    ``rows_changed_since``). ``layout_epoch`` moves whenever row IDENTITY
+    moved -- membership add/remove, order remap, schema growth, capacity
+    growth -- i.e. whenever a device buffer built against the previous
+    layout can no longer be patched row-wise and must be re-uploaded."""
+
+    epoch: int
+    layout_epoch: int
+    changed_rows: np.ndarray  # int64 row indices repacked by THIS update
+    full: bool  # True when every row was repacked (layout moved)
+
+
+@dataclass
 class NodeTensor:
     """The packed view handed to the solver. Rows [num_nodes:] are padding
     (allocatable all-zero => infeasible for any non-zero request; the
@@ -206,6 +225,7 @@ class NodeTensor:
     dims: ResourceDims
     topology_encoder: TopologyEncoder
     _row_of: Optional[Dict[str, int]] = field(default=None, repr=False)
+    delta: Optional[TensorDelta] = field(default=None, repr=False)
 
     @property
     def capacity(self) -> int:
@@ -246,6 +266,19 @@ class NodeTensorCache:
         self._topo_version = self.topology.version
         self.full_repacks = 0
         self.rows_repacked = 0
+        self.reorders = 0  # pure order remaps (no repack of unmoved rows)
+        # monotonic update epoch: every repacked row is stamped with the
+        # epoch of the update that repacked it, so device-state consumers
+        # reconcile via rows_changed_since(epoch) instead of re-diffing
+        self._epoch = 0
+        self._layout_epoch = 0
+        self._row_epoch = np.zeros(0, dtype=np.int64)
+        # change-tracking baseline: the snapshot whose change log we
+        # follow and our private read cursor into it (O(changed) update
+        # fast path; reads are cursor-based and never mutate the log, so
+        # sibling caches sharing the snapshot cannot steal our notes)
+        self._last_snapshot = None
+        self._change_cursor = 0
 
     # -- packing one node ---------------------------------------------------
 
@@ -272,6 +305,7 @@ class NodeTensorCache:
                 ni.node.metadata.labels if ni.node else {}
             )
         self._generations[i] = ni.generation
+        self._row_epoch[i] = self._epoch
 
     def _grow(self, n: int) -> None:
         cap = max(NODE_BUCKET, NODE_BUCKET * math.ceil(n / NODE_BUCKET))
@@ -281,28 +315,164 @@ class NodeTensorCache:
         self._req = np.zeros((cap, r), dtype=np.int32)
         self._nzr = np.zeros((cap, 2), dtype=np.int32)
         self._topo = np.zeros((cap, k), dtype=np.int32)
+        self._row_epoch = np.zeros(cap, dtype=np.int64)
+
+    # -- epoch handshake support --------------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    @property
+    def layout_epoch(self) -> int:
+        return self._layout_epoch
+
+    def rows_changed_since(self, epoch: int) -> np.ndarray:
+        """Row indices repacked since ``epoch`` (an ``update()``'s
+        ``delta.epoch``), valid while ``layout_epoch`` is unchanged. An
+        O(N) int compare -- never O(N*R) content work."""
+        return np.flatnonzero(self._row_epoch[: len(self._names)] > epoch)
+
+    def _register_columns(self, ni: NodeInfo) -> None:
+        dims = self.dims
+        for name in ni.allocatable.scalar:
+            dims.column(name)
+        for name in ni.requested.scalar:
+            dims.column(name)
+        for name in ni.csi_volume_limits:
+            dims.volume_column(name)
+        for name in ni.volume_in_use:
+            dims.volume_column(name)
+
+    def _build_tensor(self, n: int, delta: TensorDelta) -> NodeTensor:
+        valid = np.zeros(self._alloc.shape[0], dtype=bool)
+        valid[:n] = True
+        return NodeTensor(
+            names=self._names,
+            allocatable=self._alloc,
+            requested=self._req,
+            non_zero_requested=self._nzr,
+            valid=valid,
+            topology=self._topo,
+            dims=self.dims,
+            topology_encoder=self.topology,
+            delta=delta,
+        )
 
     # -- the update entry point --------------------------------------------
 
     def update(self, snapshot: Snapshot) -> NodeTensor:
+        """Repack changed rows and return the tensor view plus a
+        ``TensorDelta`` (``nt.delta``) naming exactly the rows this call
+        repacked, so device-state consumers reconcile in O(changed rows).
+
+        When the snapshot carries accumulated change notes (the
+        scheduler's own snapshot, refreshed by ``cache.update_snapshot``),
+        the update itself is O(changed): only the noted NodeInfos get the
+        generation compare. Foreign snapshots (tests, tools) take the
+        full generation walk -- same result, O(N) int compares."""
+        self._epoch += 1
+        tracked = None
+        membership_hint = True
+        if snapshot is self._last_snapshot:
+            tracked, membership_hint, self._change_cursor = (
+                snapshot.changes_since(self._change_cursor)
+            )
+        else:
+            # new snapshot object: establish our cursor baseline and
+            # take the full walk once
+            self._last_snapshot = snapshot
+            self._change_cursor = snapshot.change_cursor()
+        if (
+            tracked is not None
+            and not membership_hint
+            and self._names
+            and len(self._names) == len(snapshot.node_info_list)
+        ):
+            nt = self._update_tracked(snapshot, tracked)
+            if nt is not None:
+                return nt
+        return self._update_full(snapshot)
+
+    def _update_tracked(
+        self, snapshot: Snapshot, tracked
+    ) -> Optional[NodeTensor]:
+        """O(changed) fast path: only the snapshot-noted NodeInfos are
+        compared/repacked. Returns None when the notes turn out to need
+        the full walk (unknown name, node-object transition, schema or
+        topology growth)."""
+        changed_infos = []
+        row_of = self._row_of
+        info_map = snapshot.node_info_map
+        for name in tracked:
+            i = row_of.get(name)
+            ni = info_map.get(name)
+            if i is None or ni is None or ni.node is None:
+                return None  # membership drift the hint missed
+            changed_infos.append((i, ni))
+        for _i, ni in changed_infos:
+            self._register_columns(ni)
+        if (
+            self.dims.version != self._dims_version
+            or self.topology.version != self._topo_version
+        ):
+            return None  # schema grew: full repack
+        changed_rows = []
+        for i, ni in changed_infos:
+            if self._generations[i] != ni.generation:
+                self._pack_row(i, ni)
+                self.rows_repacked += 1
+                changed_rows.append(i)
+        changed_rows.sort()
+        return self._build_tensor(
+            len(self._names),
+            TensorDelta(
+                epoch=self._epoch,
+                layout_epoch=self._layout_epoch,
+                changed_rows=np.asarray(changed_rows, dtype=np.int64),
+                full=False,
+            ),
+        )
+
+    def _update_full(self, snapshot: Snapshot) -> NodeTensor:
         infos = snapshot.list_node_infos()
         names = [ni.node_name for ni in infos]
         # Register scalar-resource columns BEFORE sizing arrays: packing a
         # row must never grow the schema mid-update.
         for ni in infos:
-            for name in ni.allocatable.scalar:
-                self.dims.column(name)
-            for name in ni.requested.scalar:
-                self.dims.column(name)
-            for name in ni.csi_volume_limits:
-                self.dims.volume_column(name)
-            for name in ni.volume_in_use:
-                self.dims.volume_column(name)
+            self._register_columns(ni)
         schema_moved = (
             self.dims.version != self._dims_version
             or self.topology.version != self._topo_version
         )
         membership_moved = names != self._names
+        if (
+            membership_moved
+            and not schema_moved
+            and len(names) == len(self._names)
+            and set(names) == set(self._names)
+        ):
+            # pure ordering change: permute the packed rows to the new
+            # order instead of repacking all of them, then fall through
+            # to the normal generation compare. Row identity moved, so
+            # the layout epoch bumps (device buffers must resync).
+            m = len(names)
+            perm = np.fromiter(
+                (self._row_of[n] for n in names), dtype=np.intp, count=m
+            )
+            self._alloc[:m] = self._alloc[perm]
+            self._req[:m] = self._req[perm]
+            self._nzr[:m] = self._nzr[perm]
+            self._topo[:m] = self._topo[perm]
+            gens = self._generations
+            self._generations = [gens[j] for j in perm]
+            self._row_epoch[:m] = self._row_epoch[perm]
+            self._names = list(names)
+            self._row_of = {n: i for i, n in enumerate(names)}
+            self._layout_epoch += 1
+            self.reorders += 1
+            membership_moved = False
+        full = False
         if schema_moved or membership_moved or self._alloc.shape[0] < len(infos):
             # full repack (node set or schema changed)
             self._names = list(names)
@@ -313,25 +483,27 @@ class NodeTensorCache:
                 self._pack_row(i, ni)
             self.full_repacks += 1
             self.rows_repacked += len(infos)
+            self._layout_epoch += 1
+            full = True
+            changed_rows = np.arange(len(infos), dtype=np.int64)
         else:
+            changed = []
             for i, ni in enumerate(infos):
                 if self._generations[i] != ni.generation:
                     self._pack_row(i, ni)
                     self.rows_repacked += 1
+                    changed.append(i)
+            changed_rows = np.asarray(changed, dtype=np.int64)
         self._dims_version = self.dims.version
         self._topo_version = self.topology.version
-
-        valid = np.zeros(self._alloc.shape[0], dtype=bool)
-        valid[: len(infos)] = True
-        return NodeTensor(
-            names=self._names,
-            allocatable=self._alloc,
-            requested=self._req,
-            non_zero_requested=self._nzr,
-            valid=valid,
-            topology=self._topo,
-            dims=self.dims,
-            topology_encoder=self.topology,
+        return self._build_tensor(
+            len(infos),
+            TensorDelta(
+                epoch=self._epoch,
+                layout_epoch=self._layout_epoch,
+                changed_rows=changed_rows,
+                full=full,
+            ),
         )
 
 

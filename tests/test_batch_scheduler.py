@@ -383,6 +383,213 @@ class TestNomineeConstrainedFallback:
         assert sched.pods_fallback >= 1
 
 
+class TestDeviceStateDifferential:
+    """Randomized event-stream differential for the device-resident
+    node state (PR 5): after K batches with interleaved node churn,
+    bind failures, and schema growth, the device-resident ``req_state``
+    carry must equal a fresh full pack of the host snapshot -- and the
+    CPU (XLA) tier must have exercised the delta-scatter path."""
+
+    def test_event_stream_device_state_matches_full_pack(self, monkeypatch):
+        import random
+
+        import numpy as np
+
+        from kubernetes_tpu.cache.snapshot import Snapshot
+        from kubernetes_tpu.tensors import NodeTensorCache
+
+        rng = random.Random(20260803)
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(client, informers, batch=True, max_batch=32)
+        for i in range(8):
+            client.create_node(
+                make_node(f"ds-n{i}")
+                .capacity(cpu="64", memory="128Gi", pods=200)
+                .obj()
+            )
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+
+        # bind failures: every 4th bulk transaction rejects its first
+        # slot (the pod is forgotten + requeued, so the host diverges
+        # from the mirrored expectation -- the scatter-fix case)
+        orig_bulk = client.bind_assumed_bulk
+        calls = {"n": 0}
+
+        def flaky_bulk(assumed):
+            calls["n"] += 1
+            if calls["n"] % 4 == 0 and assumed:
+                errs = orig_bulk(assumed[1:])
+                return [(0, RuntimeError("synthetic bind failure"))] + [
+                    (i + 1, e) for i, e in errs
+                ]
+            return orig_bulk(assumed)
+
+        monkeypatch.setattr(client, "bind_assumed_bulk", flaky_bulk)
+
+        seq = 0
+        for k in range(12):
+            for _ in range(rng.randint(3, 8)):
+                seq += 1
+                client.create_pod(
+                    make_pod(f"ds-p{seq}")
+                    .container(
+                        cpu=f"{rng.choice([100, 250, 500])}m",
+                        memory="128Mi",
+                    )
+                    .obj()
+                )
+            if k % 3 == 2:
+                # external churn: a controller deletes a bound pod
+                # behind the scheduler's back
+                bound = [
+                    p for p in client.list_pods()[0] if p.spec.node_name
+                ]
+                if bound:
+                    victim = rng.choice(bound)
+                    client.delete_pod(
+                        victim.metadata.namespace, victim.metadata.name
+                    )
+            if k == 5:
+                # schema growth: a node advertising a new scalar
+                # resource forces a full repack + re-upload
+                client.create_node(
+                    make_node("ds-gpu")
+                    .capacity(
+                        cpu="8", memory="16Gi",
+                        **{"example_com__gpu": 4},
+                    )
+                    .obj()
+                )
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if sched.schedule_batch(timeout=0.2):
+                    break
+        # settle: stop injecting bind failures (a failure during the
+        # deterministic tail below would leave the device ahead with no
+        # reconciling dispatch left), absorb requeues/deletions, then
+        # stop mutating
+        monkeypatch.setattr(client, "bind_assumed_bulk", orig_bulk)
+        for _ in range(10):
+            sched.schedule_batch(timeout=0.1)
+        sched.wait_for_inflight_binds(timeout=30)
+        for _ in range(5):
+            sched.schedule_batch(timeout=0.1)
+        sched.wait_for_inflight_binds(timeout=30)
+
+        # one quiet batch reconciles the carry with the settled host
+        # state (any leftover external change resolves here) and drains
+        # the pending-delta ring
+        client.create_pod(
+            make_pod("ds-final").container(cpu="100m", memory="64Mi").obj()
+        )
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if sched.schedule_batch(timeout=0.2):
+                break
+        sched.wait_for_inflight_binds(timeout=30)
+
+        # -- deterministic path coverage (the in-loop churn above races
+        # the committer, so which resolution each divergence took is
+        # timing-dependent; these two phases are not) ------------------
+
+        # phase A: allocatable growth with nothing in flight. The next
+        # dispatch must validate the carry (row CONTENTS unchanged) and
+        # ship the one changed alloc row as an (indices, rows) scatter
+        # -- NOT a full upload.
+        node = client.get_node("ds-n0")
+        node.status.capacity["cpu"] += 1000
+        node.status.allocatable["cpu"] += 1000
+        client.update_node(node)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            ni = sched.cache._nodes.get("ds-n0")
+            if ni is not None and ni.allocatable.milli_cpu == 65000:
+                break
+            time.sleep(0.02)
+        uploads_before = sched.state_uploads
+        delta_before = sched.delta_rows_uploaded
+        client.create_pod(
+            make_pod("ds-final2").container(cpu="100m", memory="64Mi").obj()
+        )
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if sched.schedule_batch(timeout=0.2):
+                break
+        sched.wait_for_inflight_binds(timeout=30)
+        assert sched.delta_rows_uploaded > delta_before, (
+            "alloc growth should ride the row scatter"
+        )
+        assert sched.state_uploads == uploads_before, (
+            "alloc growth must not trigger a full [N, R] upload"
+        )
+
+        # phase B: external pod delete with nothing in flight -- a
+        # changed row our own mirrored placements cannot explain. The
+        # next dispatch must COUNT the divergence (scatter-fixed or
+        # resolved by a full upload, but never silent).
+        bound = [p for p in client.list_pods()[0] if p.spec.node_name]
+        victim = bound[0]
+        vnode = victim.spec.node_name
+        client.delete_pod(victim.metadata.namespace, victim.metadata.name)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            ni = sched.cache._nodes.get(vnode)
+            if ni is not None and all(
+                p.metadata.uid != victim.metadata.uid for p in ni.pods
+            ):
+                break
+            time.sleep(0.02)
+        div_before = sched.carry_divergences
+        client.create_pod(
+            make_pod("ds-final3").container(cpu="100m", memory="64Mi").obj()
+        )
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if sched.schedule_batch(timeout=0.2):
+                break
+        sched.wait_for_inflight_binds(timeout=30)
+        assert sched.carry_divergences > div_before, (
+            "the external delete must surface as a counted divergence"
+        )
+
+        ds = sched._dev
+        assert ds.req_dev is not None, "device carry was dropped"
+        dev_req = np.asarray(ds.req_dev)
+        dev_nzr = np.asarray(ds.nzr_dev)
+        names = sched.tensor_cache._names
+
+        # fresh full pack of the settled host state (shared dims +
+        # topology registries => identical columns), via a fresh
+        # snapshot so the scheduler's change tracking is untouched
+        snap2 = Snapshot()
+        sched.cache.update_snapshot(snap2)
+        fresh = NodeTensorCache(
+            sched.tensor_cache.dims, sched.tensor_cache.topology
+        ).update(snap2)
+        assert sorted(fresh.names) == sorted(names)
+        for name in names:
+            i = names.index(name)
+            j = fresh.row(name)
+            assert np.array_equal(dev_req[i], fresh.requested[j]), (
+                f"device req_state row for {name} diverged from the "
+                f"full pack: {dev_req[i]} != {fresh.requested[j]}"
+            )
+            assert np.array_equal(
+                dev_nzr[i], fresh.non_zero_requested[j]
+            ), f"device nzr_state row for {name} diverged"
+
+        # the event stream actually drove the interesting paths
+        assert sched.delta_rows_uploaded > 0
+        assert sched.carry_divergences > 0
+        assert calls["n"] >= 4
+        sched.stop()
+        informers.stop()
+
+
 class TestEagerDownload:
     """The dispatch-time result download (PR 4): on this box the core
     gate may disable it, so these tests force the path on."""

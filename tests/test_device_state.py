@@ -1,0 +1,511 @@
+"""Device-resident node-state handshake units (PR 5).
+
+The dispatcher no longer validates device-state reuse with full [N, R]
+``np.array_equal`` sweeps: ``NodeTensorCache.update`` returns a
+``TensorDelta`` (changed rows + monotonic epochs) and
+``BatchScheduler._negotiate_device_state`` reconciles O(changed rows)
+against the committer-mirrored expectation. These tests drive the
+handshake directly: the ahead-by-K committer-lag case, divergence
+scatter-fix, ring-overflow degradation, and the order-insensitive row
+remap.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.cache.cache import SchedulerCache
+from kubernetes_tpu.cache.snapshot import Snapshot
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.scheduler.batch import _SHADOW_RING_CAP
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.tensors import NodeTensorCache
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+@pytest.fixture
+def sched_stack():
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(client, informers, batch=True, max_batch=16)
+    yield sched
+    sched.stop()
+    informers.stop()
+
+
+def _cluster(n):
+    cache = SchedulerCache()
+    for i in range(n):
+        cache.add_node(
+            make_node(f"hs-{i}").capacity(cpu="8", memory="16Gi").obj()
+        )
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    return cache, snap
+
+
+def _negotiate(sched, nt, **kw):
+    kw.setdefault("overlaid", False)
+    kw.setdefault("allow_scatter", True)
+    kw.setdefault("pending_exists", False)
+    return sched._negotiate_device_state(
+        nt, nt.requested, nt.non_zero_requested, **kw
+    )
+
+
+def _prime(sched, nt):
+    """First dispatch: full upload route; fake the device refs the solve
+    would have produced (content is irrelevant to the handshake)."""
+    neg = _negotiate(sched, nt)
+    assert neg == {
+        "static_ok": False,
+        "carry_ok": False,
+        "didx": neg["didx"],
+        "sidx": neg["sidx"],
+    }
+    ds = sched._dev
+    ds.alloc_dev = object()
+    ds.valid_dev = object()
+    ds.req_dev = object()
+    ds.nzr_dev = object()
+    return neg
+
+
+def _mirror(sched, rows, req_rows, nzr_rows):
+    """What _complete_solve does when a batch commits: scatter-add the
+    placements into the running shadow and remember the per-row delta."""
+    ds = sched._dev
+    with sched._shadow_lock:
+        np.add.at(ds.req_shadow, rows, req_rows)
+        np.add.at(ds.nzr_shadow, rows, nzr_rows)
+        ds.pending_deltas.append((rows, req_rows, nzr_rows))
+
+
+def _pod_rows(nt, k):
+    r = nt.dims.num_dims
+    req_rows = np.zeros((1, r), dtype=np.int32)
+    req_rows[0, 0] = 500  # 500m cpu
+    req_rows[0, 3] = 1  # pod count
+    nzr_rows = np.asarray([[500, 128]], dtype=np.int32)
+    return (
+        np.asarray([k], dtype=np.int64),
+        req_rows,
+        nzr_rows,
+    )
+
+
+class TestHandshake:
+    def test_steady_state_pure_reuse(self, sched_stack):
+        sched = sched_stack
+        cache, snap = _cluster(5)
+        nt = sched.tensor_cache.update(snap)
+        _prime(sched, nt)
+        assert sched.state_uploads == 1
+        nt = sched.tensor_cache.update(snap)
+        neg = _negotiate(sched, nt)
+        assert neg["carry_ok"] and neg["static_ok"]
+        assert neg["didx"].size == 0 and neg["sidx"].size == 0
+        assert sched.state_reuses == 1
+        assert sched.delta_rows_uploaded == 0
+
+    def test_own_commit_explained_by_mirror(self, sched_stack):
+        """A batch commits (mirror + cache assume): the repacked row is
+        explained by the expectation -- reuse, nothing uploaded."""
+        sched = sched_stack
+        cache, snap = _cluster(5)
+        nt = sched.tensor_cache.update(snap)
+        _prime(sched, nt)
+        rows, req_rows, nzr_rows = _pod_rows(nt, 2)
+        _mirror(sched, rows, req_rows, nzr_rows)
+        pod = make_pod("own").node("hs-2").container(cpu="500m").obj()
+        # match the mirror's arithmetic: nzr defaults differ, so pin them
+        pod.__dict__["_nzr_memo"] = (500, 128 * 1024)
+        cache.add_pod(pod)
+        cache.update_snapshot(snap)
+        nt = sched.tensor_cache.update(snap)
+        assert nt.delta.changed_rows.tolist() == [2]
+        neg = _negotiate(sched, nt)
+        assert neg["carry_ok"]
+        assert neg["didx"].size == 0
+        assert sched.state_uploads == 1
+        assert len(sched._dev.pending_deltas) == 0  # confirmed
+
+    def test_ahead_by_k_committer_lag(self, sched_stack):
+        """Regression for the ahead-by-K carry case: K batches mirrored
+        but none visible in the host pack yet -- the carry must still
+        validate (the host trails the shadow by exactly the ring)."""
+        sched = sched_stack
+        cache, snap = _cluster(5)
+        nt = sched.tensor_cache.update(snap)
+        _prime(sched, nt)
+        k = _SHADOW_RING_CAP - 1
+        for i in range(k):
+            _mirror(sched, *_pod_rows(nt, i % 5))
+        nt = sched.tensor_cache.update(snap)  # host saw NOTHING yet
+        neg = _negotiate(sched, nt, pending_exists=True)
+        assert neg is not None and neg["carry_ok"]
+        # nothing confirmed: the ring still holds all K deltas
+        assert len(sched._dev.pending_deltas) == k
+        assert sched.state_uploads == 1
+
+    def test_ring_overflow_degrades_to_counted_upload(self, sched_stack):
+        """More unobserved mirrors than the ring holds: the oldest delta
+        is dropped, so the handshake can no longer explain the lag and
+        must resolve with a counted full upload -- never silently."""
+        sched = sched_stack
+        cache, snap = _cluster(5)
+        nt = sched.tensor_cache.update(snap)
+        _prime(sched, nt)
+        for i in range(_SHADOW_RING_CAP + 2):
+            _mirror(sched, *_pod_rows(nt, i % 5))
+        assert len(sched._dev.pending_deltas) == _SHADOW_RING_CAP
+        # host now shows NONE of them; commits land in the cache so the
+        # rows repack with host-side content the shadow can't explain
+        for i in range(5):
+            pod = (
+                make_pod(f"lag-{i}").node(f"hs-{i}")
+                .container(cpu="250m").obj()
+            )
+            cache.add_pod(pod)
+        cache.update_snapshot(snap)
+        nt = sched.tensor_cache.update(snap)
+        neg = _negotiate(sched, nt)
+        assert not neg["carry_ok"]
+        assert sched.state_uploads == 2
+        assert sched.carry_divergences >= 1
+
+    def test_external_divergence_scatter_fixed(self, sched_stack):
+        """An external change (pod removed behind the scheduler's back)
+        with nothing in flight: the changed rows ride a scatter patch,
+        not a full upload."""
+        sched = sched_stack
+        cache, snap = _cluster(5)
+        pod = make_pod("ext").node("hs-3").container(cpu="1").obj()
+        cache.add_pod(pod)
+        cache.update_snapshot(snap)
+        nt = sched.tensor_cache.update(snap)
+        _prime(sched, nt)
+        cache.remove_pod(pod)  # external: never mirrored
+        cache.update_snapshot(snap)
+        nt = sched.tensor_cache.update(snap)
+        neg = _negotiate(sched, nt)
+        assert neg["carry_ok"]
+        assert neg["didx"].tolist() == [3]
+        assert sched.carry_divergences == 1
+        assert sched.delta_rows_uploaded == 1
+        assert sched.state_uploads == 1  # no second full upload
+        # shadow reconciled to host truth
+        assert np.array_equal(
+            sched._dev.req_shadow[3], nt.requested[3]
+        )
+
+    def test_divergence_with_inflight_batches_drains(self, sched_stack):
+        """Divergence while batches are in flight cannot be patched in
+        place (the carry is ahead of the host): the caller must drain."""
+        sched = sched_stack
+        cache, snap = _cluster(5)
+        pod = make_pod("ext2").node("hs-1").container(cpu="1").obj()
+        cache.add_pod(pod)
+        cache.update_snapshot(snap)
+        nt = sched.tensor_cache.update(snap)
+        _prime(sched, nt)
+        cache.remove_pod(pod)
+        cache.update_snapshot(snap)
+        nt = sched.tensor_cache.update(snap)
+        assert _negotiate(sched, nt, pending_exists=True) is None
+
+    def test_allocatable_change_rides_scatter(self, sched_stack):
+        """A node's capacity update (same membership) patches the
+        resident allocatable by row instead of re-uploading it."""
+        sched = sched_stack
+        cache, snap = _cluster(5)
+        nt = sched.tensor_cache.update(snap)
+        _prime(sched, nt)
+        cache.add_node(
+            make_node("hs-4").capacity(cpu="32", memory="64Gi").obj()
+        )
+        cache.update_snapshot(snap)
+        nt = sched.tensor_cache.update(snap)
+        neg = _negotiate(sched, nt)
+        assert neg["carry_ok"] and neg["static_ok"]
+        assert neg["sidx"].tolist() == [4]
+        assert sched.delta_rows_uploaded == 1
+        assert np.array_equal(
+            sched._dev.alloc_shadow[4], nt.allocatable[4]
+        )
+
+    def test_membership_change_forces_full_upload(self, sched_stack):
+        sched = sched_stack
+        cache, snap = _cluster(5)
+        nt = sched.tensor_cache.update(snap)
+        _prime(sched, nt)
+        cache.add_node(
+            make_node("hs-new").capacity(cpu="8", memory="16Gi").obj()
+        )
+        cache.update_snapshot(snap)
+        nt = sched.tensor_cache.update(snap)
+        assert nt.delta.full
+        neg = _negotiate(sched, nt)
+        assert not neg["static_ok"] and not neg["carry_ok"]
+        assert sched.state_uploads == 2
+
+    def test_mesh_mode_full_upload_fallback(self, sched_stack):
+        """allow_scatter=False (the multichip path): any change resolves
+        as a counted full upload, never a scatter."""
+        sched = sched_stack
+        cache, snap = _cluster(5)
+        pod = make_pod("m").node("hs-0").container(cpu="1").obj()
+        cache.add_pod(pod)
+        cache.update_snapshot(snap)
+        nt = sched.tensor_cache.update(snap)
+        _prime(sched, nt)
+        cache.remove_pod(pod)
+        cache.update_snapshot(snap)
+        nt = sched.tensor_cache.update(snap)
+        neg = _negotiate(sched, nt, allow_scatter=False)
+        assert not neg["carry_ok"]
+        assert neg["didx"].size == 0 and neg["sidx"].size == 0
+        assert sched.state_uploads == 2
+        assert sched.carry_divergences == 1
+
+
+class TestTensorDeltaReorder:
+    def test_pure_reorder_remaps_without_repack(self):
+        """Satellite: a pure node-ordering change must NOT repack all
+        rows -- the cache permutes them and bumps only the layout
+        epoch."""
+        cache, snap = _cluster(6)
+        tc = NodeTensorCache()
+        nt1 = tc.update(snap)
+        assert tc.full_repacks == 1
+        repacked = tc.rows_repacked
+        content = {
+            name: nt1.allocatable[nt1.row(name)].copy()
+            for name in nt1.names
+        }
+        # rebuild the snapshot map in a rotated order (same node set)
+        names = list(snap.node_info_map)
+        rotated = names[2:] + names[:2]
+        snap.node_info_map = {n: snap.node_info_map[n] for n in rotated}
+        snap.refresh_lists()
+        nt2 = tc.update(snap)
+        assert tc.full_repacks == 1  # NOT a membership change
+        assert tc.reorders == 1
+        assert tc.rows_repacked == repacked  # zero rows repacked
+        assert nt2.names == rotated
+        assert nt2.delta.layout_epoch > nt1.delta.layout_epoch
+        for name in rotated:
+            assert np.array_equal(
+                nt2.allocatable[nt2.row(name)], content[name]
+            ), name
+
+    def test_reorder_plus_changed_row_repacks_only_that_row(self):
+        cache, snap = _cluster(6)
+        tc = NodeTensorCache()
+        tc.update(snap)
+        repacked = tc.rows_repacked
+        pod = make_pod("rr").node("hs-5").container(cpu="2").obj()
+        cache.add_pod(pod)
+        cache.update_snapshot(snap)
+        names = list(snap.node_info_map)
+        snap.node_info_map = {
+            n: snap.node_info_map[n] for n in reversed(names)
+        }
+        snap.refresh_lists()
+        nt = tc.update(snap)
+        assert tc.full_repacks == 1
+        assert tc.reorders == 1
+        assert tc.rows_repacked == repacked + 1
+        assert nt.requested[nt.row("hs-5"), 0] == 2000
+
+    def test_true_add_remove_still_full_repacks(self):
+        cache, snap = _cluster(3)
+        tc = NodeTensorCache()
+        tc.update(snap)
+        cache.add_node(make_node("hs-x").capacity(cpu="1").obj())
+        cache.update_snapshot(snap)
+        nt = tc.update(snap)
+        assert tc.full_repacks == 2
+        assert nt.delta.full
+
+
+class TestTensorDeltaEpochs:
+    def test_changed_rows_and_epoch_monotonic(self):
+        cache, snap = _cluster(4)
+        tc = NodeTensorCache()
+        nt1 = tc.update(snap)
+        assert nt1.delta.full
+        assert nt1.delta.changed_rows.tolist() == [0, 1, 2, 3]
+        pod = make_pod("e").node("hs-1").container(cpu="1").obj()
+        cache.add_pod(pod)
+        cache.update_snapshot(snap)
+        nt2 = tc.update(snap)
+        assert nt2.delta.epoch > nt1.delta.epoch
+        assert nt2.delta.layout_epoch == nt1.delta.layout_epoch
+        assert nt2.delta.changed_rows.tolist() == [1]
+        assert tc.rows_changed_since(nt1.delta.epoch).tolist() == [1]
+        assert tc.rows_changed_since(nt2.delta.epoch).size == 0
+
+    def test_sibling_consumers_do_not_steal_change_notes(self):
+        """Regression: the preemptor's sibling cache and the prewarm
+        thread's fresh cache update() against the SAME shared snapshot
+        as the scheduler's tensor cache -- a one-shot note consume
+        would let one consumer steal another's changed rows (silently
+        stale packs). Reads are cursor-based now: every consumer sees
+        every change."""
+        cache, snap = _cluster(4)
+        tc1, tc2 = NodeTensorCache(), NodeTensorCache()
+        tc1.update(snap)
+        tc2.update(snap)
+        pod = make_pod("sib").node("hs-2").container(cpu="1").obj()
+        cache.add_pod(pod)
+        cache.update_snapshot(snap)
+        # the OTHER consumer reads first...
+        nt2 = tc2.update(snap)
+        assert nt2.delta.changed_rows.tolist() == [2]
+        # ...and tc1 still sees the change (and packs the row)
+        nt1 = tc1.update(snap)
+        assert nt1.delta.changed_rows.tolist() == [2]
+        assert nt1.requested[2, 0] == 1000
+        assert nt2.requested[2, 0] == 1000
+
+    def test_foreign_snapshot_full_walk_same_result(self):
+        """A snapshot the cache has no baseline for still packs
+        correctly (tests/tools construct fresh snapshots)."""
+        from kubernetes_tpu.cache.snapshot import new_snapshot
+
+        node = make_node("f").capacity(cpu="4", memory="8Gi").obj()
+        pod = make_pod("fp").node("f").container(cpu="1").obj()
+        tc = NodeTensorCache()
+        nt = tc.update(new_snapshot([pod], [node]))
+        assert nt.requested[nt.row("f"), 0] == 1000
+        nt = tc.update(new_snapshot([pod], [node]))
+        assert nt.requested[nt.row("f"), 0] == 1000
+
+
+class TestApplyAssignmentDelta:
+    def test_no_node_slots_drop_instead_of_wrapping(self):
+        """Regression: JAX wraps negative indices even with
+        ``mode="drop"`` -- NO_NODE (-1) slots must not scatter their
+        pod rows onto the LAST node row of the resident state."""
+        import jax.numpy as jnp
+
+        from kubernetes_tpu.ops.assignment import (
+            NO_NODE,
+            apply_assignment_delta,
+        )
+
+        req = jnp.zeros((4, 3), dtype=jnp.int32)
+        nzr = jnp.zeros((4, 2), dtype=jnp.int32)
+        assigns = np.asarray([NO_NODE, 2, NO_NODE, 7], dtype=np.int32)
+        pod_req = np.full((4, 3), 5, dtype=np.int32)
+        pod_nzr = np.full((4, 2), 7, dtype=np.int32)
+        req2, nzr2 = apply_assignment_delta(
+            req, nzr, assigns, pod_req, pod_nzr
+        )
+        req2, nzr2 = np.asarray(req2), np.asarray(nzr2)
+        assert req2[2].tolist() == [5, 5, 5]  # the one placed pod
+        assert nzr2[2].tolist() == [7, 7]
+        # NO_NODE and past-the-end slots leave every other row alone
+        for i in (0, 1, 3):
+            assert req2[i].tolist() == [0, 0, 0], f"row {i} corrupted"
+            assert nzr2[i].tolist() == [0, 0], f"row {i} corrupted"
+
+
+class TestHostTierAllocBookkeeping:
+    def test_host_tier_after_layout_change_drops_stale_alloc(
+        self, monkeypatch
+    ):
+        """Regression: the handshake books a full static upload
+        (layout moved), but the ladder lands on the HOST tier so no
+        jitted solve runs and the alloc/valid pieces never reach the
+        device -- the stale device refs must drop, or the next dispatch
+        would solve against the previous layout's allocatable."""
+        from kubernetes_tpu.robustness.ladder import TIER_HOST_GREEDY
+
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(client, informers, batch=True, max_batch=8)
+        for i in range(3):
+            client.create_node(
+                make_node(f"ht-{i}")
+                .capacity(cpu="8", memory="16Gi")
+                .obj()
+            )
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        try:
+            # dispatch 1 on the device tier: resident alloc established
+            client.create_pod(
+                make_pod("ht-p0").container(cpu="100m").obj()
+            )
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if sched.schedule_batch(timeout=0.2):
+                    break
+            sched.wait_for_inflight_binds(timeout=30)
+            assert sched._dev.alloc_dev is not None
+            assert sched.state_uploads == 1
+
+            # layout change: a node joins (full static upload booked)
+            client.create_node(
+                make_node("ht-new")
+                .capacity(cpu="8", memory="16Gi")
+                .obj()
+            )
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if "ht-new" in sched.cache._nodes:
+                    break
+                time.sleep(0.02)
+
+            # ...but the device tiers are down: the HOST tier solves
+            orig_run = sched.ladder.run
+
+            def host_only(attempts, label="batch"):
+                for tier, thunk in attempts:
+                    if tier == TIER_HOST_GREEDY:
+                        return tier, thunk()
+                return orig_run(attempts, label=label)
+
+            monkeypatch.setattr(sched.ladder, "run", host_only)
+            client.create_pod(
+                make_pod("ht-p1").container(cpu="100m").obj()
+            )
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if sched.schedule_batch(timeout=0.2):
+                    break
+            sched.wait_for_inflight_binds(timeout=30)
+            assert sched._dev.alloc_dev is None, (
+                "stale device alloc survived a host-tier solve that "
+                "never uploaded the new layout"
+            )
+            assert sched._dev.valid_dev is None
+
+            # device tier back: the next dispatch re-uploads in full
+            # and places correctly against the 4-node layout
+            monkeypatch.setattr(sched.ladder, "run", orig_run)
+            uploads = sched.state_uploads
+            client.create_pod(
+                make_pod("ht-p2").container(cpu="100m").obj()
+            )
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if sched.schedule_batch(timeout=0.2):
+                    break
+            sched.wait_for_inflight_binds(timeout=30)
+            assert sched.state_uploads == uploads + 1
+            bound = [
+                p for p in client.list_pods()[0] if p.spec.node_name
+            ]
+            assert len(bound) == 3
+        finally:
+            sched.stop()
+            informers.stop()

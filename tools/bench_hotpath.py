@@ -1,7 +1,8 @@
-"""Host-stage microbenchmarks: queue drain, pack, commit gather/assume.
+"""Host-stage microbenchmarks: queue drain, pack, commit gather/assume,
+node-state delta update + reuse check.
 
 The end-to-end bench (bench.py) measures the pipeline; this tool
-isolates the three host stages PR 4 vectorized so a regression in any
+isolates the host stages PR 4/PR 5 vectorized so a regression in any
 one of them is visible WITHOUT the noise of the full burst (informers,
 solver, bind pool). Synthetic input, no scheduler stack, no device work.
 
@@ -12,7 +13,16 @@ Prints ONE JSON line:
    "queue_drain_perpod_ms": the same drain via per-pod pop() calls,
    "pack_ms":            pack_pod_batch over the N pods,
    "commit_gather_ms":   argsort split + native commit_gather,
-   "commit_assume_ms":   node-grouped cache.assume_pods of the clones}
+   "commit_assume_ms":   node-grouped cache.assume_pods of the clones,
+   "node_update_ms_churn{0,1pct,100pct}":
+                         NodeTensorCache.update() at M nodes when 0% /
+                         1% / 100% of rows changed since the last pack,
+   "reuse_check_ms_churn{0,1pct,100pct}":
+                         the dispatch generation handshake (epoch compare
+                         + changed-row content check) at the same churn,
+   "reuse_check_full_sweep_ms":
+                         the RETIRED pre-PR-5 validation (full [N, R]
+                         np.array_equal sweep), for scale}
 
 Usage: python tools/bench_hotpath.py [--pods 10000] [--nodes 5000]
 """
@@ -125,6 +135,75 @@ def bench_commit(pods, node_names):
     return gather_ms, assume_ms
 
 
+def bench_node_state(num_nodes):
+    """The PR-5 node-state path: update() delta cost and the dispatch
+    reuse check under 0% / 1% / 100% row churn, against a cluster the
+    SchedulerCache change-tracks (the production shape)."""
+    from kubernetes_tpu.cache.cache import SchedulerCache
+    from kubernetes_tpu.cache.snapshot import Snapshot
+    from kubernetes_tpu.tensors import NodeTensorCache
+    from kubernetes_tpu.testing import make_node, make_pod
+
+    cache = SchedulerCache()
+    for i in range(num_nodes):
+        cache.add_node(
+            make_node(f"bn-{i}")
+            .capacity(cpu="16", memory="32Gi", pods=110)
+            .obj()
+        )
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    tc = NodeTensorCache()
+    nt = tc.update(snap)  # cold full pack establishes the baseline
+
+    out = {}
+    seq = 0
+    for churn, label in ((0.0, "0"), (0.01, "1pct"), (1.0, "100pct")):
+        k = int(num_nodes * churn)
+        for i in range(k):
+            seq += 1
+            cache.add_pod(
+                make_pod(f"ch-{seq}").node(f"bn-{i}")
+                .container(cpu="100m").obj()
+            )
+        cache.update_snapshot(snap)
+        prev_epoch = nt.delta.epoch
+        t0 = time.perf_counter()
+        nt = tc.update(snap)
+        out[f"node_update_ms_churn{label}"] = (
+            time.perf_counter() - t0
+        ) * 1000
+        assert nt.delta.changed_rows.size == k, (
+            f"delta reported {nt.delta.changed_rows.size} rows, "
+            f"expected {k}"
+        )
+        # the dispatch handshake: shadow equals the expectation (pure
+        # reuse), so this measures the steady-state validation cost
+        shadow_req = nt.requested.copy()
+        shadow_nzr = nt.non_zero_requested.copy()
+        t0 = time.perf_counter()
+        changed = tc.rows_changed_since(prev_epoch)
+        if changed.size:
+            ok = np.all(
+                nt.requested[changed] == shadow_req[changed]
+            ) and np.all(
+                nt.non_zero_requested[changed] == shadow_nzr[changed]
+            )
+            assert ok
+        out[f"reuse_check_ms_churn{label}"] = (
+            time.perf_counter() - t0
+        ) * 1000
+    # the retired validation, for scale: one full-array sweep (the old
+    # code ran one per shadow generation in the ring)
+    shadow_req = nt.requested.copy()
+    shadow_nzr = nt.non_zero_requested.copy()
+    t0 = time.perf_counter()
+    assert np.array_equal(nt.requested, shadow_req)
+    assert np.array_equal(nt.non_zero_requested, shadow_nzr)
+    out["reuse_check_full_sweep_ms"] = (time.perf_counter() - t0) * 1000
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--pods", type=int, default=10000)
@@ -149,21 +228,20 @@ def main() -> None:
     drain_ms, drain_perpod_ms = bench_queue_drain(pods, args.batch)
     pack_ms = bench_pack(pods)
     gather_ms, assume_ms = bench_commit(pods, node_names)
+    node_state = bench_node_state(args.nodes)
 
-    print(
-        json.dumps(
-            {
-                "metric": "hotpath_microbench",
-                "pods": args.pods,
-                "nodes": args.nodes,
-                "queue_drain_ms": round(drain_ms, 2),
-                "queue_drain_perpod_ms": round(drain_perpod_ms, 2),
-                "pack_ms": round(pack_ms, 2),
-                "commit_gather_ms": round(gather_ms, 2),
-                "commit_assume_ms": round(assume_ms, 2),
-            }
-        )
-    )
+    record = {
+        "metric": "hotpath_microbench",
+        "pods": args.pods,
+        "nodes": args.nodes,
+        "queue_drain_ms": round(drain_ms, 2),
+        "queue_drain_perpod_ms": round(drain_perpod_ms, 2),
+        "pack_ms": round(pack_ms, 2),
+        "commit_gather_ms": round(gather_ms, 2),
+        "commit_assume_ms": round(assume_ms, 2),
+    }
+    record.update({k: round(v, 3) for k, v in node_state.items()})
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":
