@@ -186,6 +186,180 @@ def _build_pod(name: str, spec: Dict[str, Any], idx: int):
     return w.obj()
 
 
+def _wait_fraction_bound(coll: BindCollector, frac: float, timeout: float) -> bool:
+    """Block until ``frac`` of the collector's targets have bound (the
+    lifecycle scenarios trigger mid-burst, not at t=0)."""
+    need = int(frac * len(coll._targets))
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        with coll._cond:
+            if len(coll._targets) - coll._outstanding >= need:
+                return True
+        time.sleep(0.05)
+    return False
+
+
+def _wait_live_bound(client: Client, timeout: float) -> bool:
+    """Every pod currently in the apiserver is bound -- the lifecycle
+    settle condition (respawned incarnations included, which the
+    name-keyed collector cannot see)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        pods, _ = client.list_pods()
+        if pods and all(p.spec.node_name for p in pods):
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def _lifecycle_setup(
+    lifecycle: Dict[str, Any],
+    wl: Dict[str, Any],
+    server: APIServer,
+    client: Client,
+    informers: InformerFactory,
+    num_nodes: int,
+    injector,
+):
+    """Build the scenario actor for a ``lifecycle:`` workload. Returns
+    (components-to-stop, scenario(coll, timeout_s) callable, counters,
+    stop event that aborts an in-progress scenario)."""
+    from kubernetes_tpu.api.types import LabelSelector, PodDisruptionBudget
+    from kubernetes_tpu.controllers import DisruptionController, NodeDrainer
+    from kubernetes_tpu.robustness.faults import (
+        FaultInjector, FaultPoint, FaultProfile, PointConfig,
+    )
+    from kubernetes_tpu.robustness.lifecycle import (
+        ClusterLifecycleDriver, PodRespawner,
+    )
+
+    mode = lifecycle.get("mode", "drain_wave")
+    at_fraction = float(lifecycle.get("at_fraction", 0.3))
+    stoppers = []
+    counters: Dict[str, Any] = {"mode": mode}
+    # teardown signal: the scenario thread (and any in-progress drain)
+    # must be interruptible, or an exception path leaves a daemon
+    # draining nodes under the settle checks for minutes
+    stop_evt = threading.Event()
+
+    if mode == "drain_wave":
+        disruption = DisruptionController(client, informers)
+        disruption.start()
+        stoppers.append(disruption)
+        pdb_spec = lifecycle.get("pdb")
+        if pdb_spec:
+            pdb = PodDisruptionBudget(
+                selector=LabelSelector(
+                    match_labels=dict(pdb_spec.get("match_labels") or {})
+                ),
+                min_available=pdb_spec.get("min_available"),
+                max_unavailable=pdb_spec.get("max_unavailable"),
+            )
+            pdb.metadata.name = "wave-budget"
+            pdb.metadata.namespace = "default"
+            client.create_pdb(pdb)
+        respawner = PodRespawner(client)
+        respawner.start()
+        stoppers.append(respawner)
+        drainer = NodeDrainer(
+            client, disruption=disruption, should_abort=stop_evt.is_set
+        )
+
+        counters["drainer"] = drainer
+        counters["respawner"] = respawner
+
+        def scenario(coll, timeout_s):
+            _wait_fraction_bound(coll, at_fraction, timeout_s)
+            waves = int(lifecycle.get("waves", 3))
+            per = int(lifecycle.get("nodes_per_wave", 2))
+            wave_timeout = float(lifecycle.get("wave_timeout_s", 60))
+            idx = 0
+            for _w in range(waves):
+                if stop_evt.is_set():
+                    return
+                victims = [
+                    f"node-{(idx + j) % num_nodes}" for j in range(per)
+                ]
+                idx += per
+                for v in victims:
+                    if stop_evt.is_set():
+                        return
+                    drainer.drain(v, timeout=wave_timeout)
+                # the wave is "upgraded": back into service before the
+                # next wave cordons -- rolling, never net capacity loss
+                if lifecycle.get("uncordon", True):
+                    for v in victims:
+                        drainer.uncordon(v)
+
+        return stoppers, scenario, counters, stop_evt
+
+    if mode in ("reclaim_storm", "chaos"):
+        if mode == "reclaim_storm":
+            # a private injector (never installed): deterministic storm
+            # count, no solver faults
+            injector = FaultInjector(FaultProfile(
+                name="bench-reclaim", seed=int(wl.get("fault_seed", 0)),
+                points={FaultPoint.RECLAIM_STORM: PointConfig(
+                    rate=1.0,
+                    max_fires=int(lifecycle.get("storms", 1)),
+                )},
+            ))
+        assert injector is not None, "chaos mode needs fault_profile"
+        driver = ClusterLifecycleDriver(
+            client,
+            injector=injector,
+            tick_interval=float(lifecycle.get("tick_interval", 0.2)),
+            flap_down_seconds=float(lifecycle.get("flap_down_seconds", 0.5)),
+            storm_fraction=float(lifecycle.get("storm_fraction", 0.1)),
+            storm_down_seconds=float(
+                lifecycle.get("storm_down_seconds", 1.0)
+            ),
+        )
+        stoppers.append(driver)
+
+        counters["driver"] = driver  # resolved to numbers at teardown
+
+        def scenario(coll, timeout_s):
+            _wait_fraction_bound(coll, at_fraction, timeout_s)
+            driver.start()
+            # hold the scenario open until the chaos actually landed
+            # (teardown stops the driver; a fast burst would otherwise
+            # outrun the first tick) and the reclaimed capacity is back
+            min_events = int(lifecycle.get("min_events", 1))
+            deadline = time.time() + float(lifecycle.get("duration_s", 30))
+            while time.time() < deadline and not stop_evt.is_set():
+                if (
+                    driver.flaps + driver.storms >= min_events
+                    and driver.down_count() == 0
+                ):
+                    break
+                time.sleep(0.1)
+
+        return stoppers, scenario, counters, stop_evt
+
+    if mode == "scale_up":
+        node_spec = wl.get("node") or {}
+
+        def scenario(coll, timeout_s):
+            # the trigger: the burst saturates the starved cluster
+            _wait_fraction_bound(coll, at_fraction, timeout_s)
+            add = int(lifecycle.get("add_nodes", num_nodes // 10))
+            for i in range(add):
+                nw = make_node(f"cold-{i}").capacity(
+                    cpu=str(node_spec.get("cpu", "32")),
+                    memory=str(node_spec.get("memory", "64Gi")),
+                    pods=int(node_spec.get("pods", 110)),
+                )
+                nw.label(ZONE_LABEL, f"zone-{i % 10}")
+                nw.label(HOSTNAME_LABEL, f"cold-{i}")
+                client.create_node(nw.obj())
+            counters["nodes_added"] = add
+
+        return stoppers, scenario, counters, stop_evt
+
+    raise ValueError(f"unknown lifecycle mode {mode!r}")
+
+
 def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]:
     name = wl["name"]
     num_nodes = int(wl["nodes"])
@@ -326,6 +500,34 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
                 )
             )
 
+    # workload-scoped fault profile (the chaos-profile variants): the
+    # injector is installed for the whole run and ALWAYS uninstalled on
+    # exit so the next matrix entry starts clean
+    injector = None
+    if wl.get("fault_profile"):
+        from kubernetes_tpu.robustness.faults import (
+            FaultInjector, install_injector, load_profile,
+        )
+
+        injector = FaultInjector(load_profile(
+            wl["fault_profile"], seed=int(wl.get("fault_seed", 0))
+        ))
+        install_injector(injector)
+
+    lifecycle = wl.get("lifecycle")
+    lifecycle_stoppers: List[Any] = []
+    lifecycle_scenario = None
+    lifecycle_counters: Dict[str, Any] = {}
+    lifecycle_stop = None
+    if lifecycle:
+        (
+            lifecycle_stoppers, lifecycle_scenario,
+            lifecycle_counters, lifecycle_stop,
+        ) = _lifecycle_setup(
+            lifecycle, wl, server, client, informers, num_nodes,
+            injector,
+        )
+
     hollow = None
     if wl.get("hollow"):
         # hollow-node pool (kubemark pattern, hollow_kubelet.go:64):
@@ -395,7 +597,16 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         pod_spec = wl.get("pod") or {}
         pods = []
         for i in range(measure_pods):
-            p = _build_pod(f"measure-{i}", pod_spec, i)
+            spec_i = pod_spec
+            if wl.get("daemonset"):
+                # DaemonSet-style fan-out: pod i pins to node i -- every
+                # pod carries a DISTINCT nodeSelector, so the static
+                # mask is per-pod, not per-batch
+                spec_i = dict(pod_spec)
+                spec_i["node_selector"] = {
+                    HOSTNAME_LABEL: f"node-{i % num_nodes}"
+                }
+            p = _build_pod(f"measure-{i}", spec_i, i)
             if gang:
                 p.metadata.labels[POD_GROUP_LABEL] = (
                     f"group-{i // int(gang.get('group_size', 10))}"
@@ -412,6 +623,15 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         _timeline.reset()
         start = time.perf_counter()
         _timeline.mark("burst_start")
+        scenario_thread = None
+        if lifecycle_scenario is not None:
+            scenario_thread = threading.Thread(
+                target=lifecycle_scenario,
+                args=(coll, timeout_s),
+                name="lifecycle-scenario",
+                daemon=True,
+            )
+            scenario_thread.start()
         ok = True
         if churn:
             # BASELINE #5: steady-state churn -- delete a slice of running
@@ -461,6 +681,41 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         if _timeline.ENABLED:
             print(_timeline.dump(start), file=sys.stderr, flush=True)
         sched.wait_for_inflight_binds(timeout=60)
+
+        if lifecycle:
+            # teardown restores reclaimed capacity (driver.stop());
+            # THEN every live incarnation must place -- respawned
+            # clones are invisible to the name-keyed collector
+            if scenario_thread is not None:
+                scenario_thread.join(timeout=timeout_s)
+                if scenario_thread.is_alive():
+                    lifecycle_stop.set()  # deadline passed: abort it
+                    scenario_thread.join(timeout=30)
+            for comp in lifecycle_stoppers:
+                comp.stop()
+            lifecycle_stoppers = []
+            settled = _wait_live_bound(client, 120.0)
+            sched.wait_for_inflight_binds(timeout=60)
+            drv = lifecycle_counters.pop("driver", None)
+            if drv is not None:
+                lifecycle_counters.update(
+                    flaps=drv.flaps, storms=drv.storms,
+                    nodes_reclaimed=drv.nodes_reclaimed,
+                    pods_killed=drv.pods_killed,
+                    pods_respawned=drv.pods_respawned,
+                )
+            drn = lifecycle_counters.pop("drainer", None)
+            if drn is not None:
+                lifecycle_counters.update(
+                    evictions=drn.evictions,
+                    evictions_blocked=drn.evictions_blocked,
+                    drains_completed=drn.drains,
+                )
+            rsp = lifecycle_counters.pop("respawner", None)
+            if rsp is not None:
+                lifecycle_counters["pods_respawned"] = rsp.respawned
+            lifecycle_counters["settled"] = settled
+            ok = ok and settled
 
         bound = sum(1 for n in target_names if n in coll.bind_times)
         # capacity-starved workloads (GangContention) EXPECT a fraction
@@ -551,8 +806,21 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
             "carry_divergences": getattr(
                 sched, "carry_divergences", 0
             ),
+            "membership_row_patches": getattr(
+                sched, "membership_row_patches", 0
+            ),
             "gang_resolves": sched.gang_resolves,
         }
+        tc = getattr(sched, "tensor_cache", None)
+        if tc is not None:
+            # churn observability: slot adds/retires vs counted full
+            # repacks (a lifecycle workload should move the first two
+            # and leave full_repacks at the one cold pack)
+            result["solver"]["tensor_full_repacks"] = tc.full_repacks
+            result["solver"]["tensor_rows_added"] = tc.rows_added
+            result["solver"]["tensor_rows_retired"] = tc.rows_retired
+        if lifecycle_counters:
+            result["lifecycle"] = lifecycle_counters
         return result
     finally:
         # EVERY component stops on EVERY exit path (including exceptions
@@ -561,6 +829,17 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         # perturb every later workload in the matrix
         if coll is not None:
             coll.stop()
+        if lifecycle_stop is not None:
+            lifecycle_stop.set()
+        for comp in lifecycle_stoppers:
+            try:
+                comp.stop()
+            except Exception:  # noqa: BLE001 - teardown keeps going
+                pass
+        if injector is not None:
+            from kubernetes_tpu.robustness.faults import install_injector
+
+            install_injector(None)
         sched.stop()
         if hollow is not None:
             hollow.stop()
@@ -574,6 +853,12 @@ def to_data_items(results: List[Dict[str, Any]]) -> Dict[str, Any]:
         labels = {"Name": r["name"]}
         labels.update(
             {f"solver_{k}": str(v) for k, v in (r.get("solver") or {}).items()}
+        )
+        labels.update(
+            {
+                f"lifecycle_{k}": str(v)
+                for k, v in (r.get("lifecycle") or {}).items()
+            }
         )
         if r.get("error") or not r.get("ok", False):
             labels["error"] = r.get("error", f"{r.get('bound')}/{r.get('total')} bound")

@@ -32,6 +32,11 @@ class _PodState:
     assumed: bool = False
     binding_finished: bool = False
     deadline: Optional[float] = None  # absolute expiry, set by finish_binding
+    # the pod's node was deleted while this pod was assumed (drain /
+    # spot reclamation racing an in-flight bind): expire on the NEXT
+    # sweeper pass instead of waiting out the assume TTL -- the sweeper
+    # routes the pod by apiserver truth either way
+    node_removed: bool = False
 
 
 class SchedulerCache:
@@ -115,16 +120,24 @@ class SchedulerCache:
             state = self._pod_states.get(key)
             if state and state.assumed:
                 state.binding_finished = True
-                state.deadline = self._now() + self._ttl
+                # node deleted while the bind was in flight: expire NOW
+                # (the sweeper's next pass routes by apiserver truth)
+                state.deadline = (
+                    self._now() if state.node_removed
+                    else self._now() + self._ttl
+                )
 
     def finish_binding_bulk(self, pods: List[Pod]) -> None:
         with self._lock:
-            deadline = self._now() + self._ttl
+            now = self._now()
+            deadline = now + self._ttl
             for pod in pods:
                 state = self._pod_states.get(pod.metadata.uid)
                 if state and state.assumed:
                     state.binding_finished = True
-                    state.deadline = deadline
+                    state.deadline = (
+                        now if state.node_removed else deadline
+                    )
 
     def forget_pod(self, pod: Pod) -> None:
         key = pod.metadata.uid
@@ -260,14 +273,30 @@ class SchedulerCache:
 
     def remove_node(self, node: Node) -> None:
         with self._lock:
-            ni = self._nodes.pop(node.metadata.name, None)
+            name = node.metadata.name
+            ni = self._nodes.pop(name, None)
             if ni is not None and ni.pods:
                 # Keep a nodeless NodeInfo while pods remain (reference
                 # removes the node object but keeps pod accounting;
                 # cache.go:582). We keep the entry with node=None.
                 ni.node = None
                 ni.generation = next_generation()
-                self._nodes[node.metadata.name] = ni
+                self._nodes[name] = ni
+            # Assumed pods stranded on the deleted node (drain / spot
+            # reclamation racing an in-flight bind) fast-expire: the
+            # resilience sweeper's NEXT pass routes them by apiserver
+            # truth instead of waiting out the assume TTL. Pods whose
+            # bind is still in flight get the now-deadline when
+            # finish_binding lands (expiring mid-bind would race the
+            # committer's bookkeeping).
+            now = self._now()
+            for key in self._assumed_pods:
+                state = self._pod_states[key]
+                if state.pod.spec.node_name != name:
+                    continue
+                state.node_removed = True
+                if state.binding_finished:
+                    state.deadline = now
 
     # -- CSINode events (attachable-volume limits) --------------------------
 
@@ -334,6 +363,11 @@ class SchedulerCache:
                 state = self._pod_states[key]
                 if state.binding_finished and state.deadline is not None:
                     if now >= state.deadline:
+                        if state.node_removed:
+                            # attribution for the sweeper's metric: this
+                            # expiry is a node-removal fast path, not a
+                            # lost bind confirmation
+                            state.pod.__dict__["_node_removed_expired"] = True
                         expired.append(state.pod)
                         self._remove_pod_from_node(state.pod)
                         del self._pod_states[key]

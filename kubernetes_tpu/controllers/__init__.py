@@ -8,6 +8,9 @@ maintains PDB.Status.DisruptionsAllowed, the budget preemption spends
 """
 
 from kubernetes_tpu.controllers.disruption import DisruptionController
-from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
+from kubernetes_tpu.controllers.nodelifecycle import (
+    NodeDrainer,
+    NodeLifecycleController,
+)
 
-__all__ = ["DisruptionController", "NodeLifecycleController"]
+__all__ = ["DisruptionController", "NodeDrainer", "NodeLifecycleController"]

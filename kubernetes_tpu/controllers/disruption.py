@@ -28,6 +28,7 @@ from typing import Optional, Set, Tuple
 from kubernetes_tpu.api.selectors import labels_match_selector
 from kubernetes_tpu.api.types import Pod, PodDisruptionBudget
 from kubernetes_tpu.client.informer import InformerFactory, ResourceEventHandler
+from kubernetes_tpu.utils import metrics
 
 logger = logging.getLogger(__name__)
 
@@ -89,6 +90,96 @@ class DisruptionController:
         if matched:
             with self._cond:
                 self._cond.notify()
+
+    # -- the shared voluntary-disruption gate ---------------------------------
+
+    def pdbs_for_pod(self, pod: Pod) -> list:
+        """Every PDB whose selector matches the pod (disruption.go
+        getPdbForPod)."""
+        out = []
+        for pdb in self._pdbs.list():
+            if pdb.metadata.namespace != pod.metadata.namespace:
+                continue
+            if pdb.selector is None:
+                continue
+            if labels_match_selector(pod.metadata.labels, pdb.selector):
+                out.append(pdb)
+        return out
+
+    def can_disrupt(self, pod: Pod) -> bool:
+        """The Eviction-subresource gate shared by EVERY voluntary
+        disruption path (node drains AND taint evictions): the pod may
+        only be disrupted when every matching PDB still has budget, and
+        a granted disruption CONSUMES one unit from each -- decremented
+        through the apiserver's guaranteed_update so concurrent evictors
+        contend on the same counter instead of double-spending a stale
+        informer read (registry/core/pod/storage/eviction.go:141
+        checkAndDecrement). The reconcile loop recomputes the budget as
+        evicted pods actually terminate, re-opening it."""
+        matching = self.pdbs_for_pod(pod)
+        if not matching:
+            return True
+        granted = []
+        for pdb in matching:
+            ok = {}
+
+            def check_and_decrement(p: PodDisruptionBudget) -> None:
+                if p.status.disruptions_allowed > 0:
+                    p.status.disruptions_allowed -= 1
+                    ok["granted"] = True
+                else:
+                    ok["granted"] = False
+
+            try:
+                self.client.update_pdb_status(
+                    pdb.metadata.namespace, pdb.metadata.name,
+                    check_and_decrement,
+                )
+            except KeyError:
+                continue  # PDB deleted mid-check: it no longer binds
+            except Exception:
+                logger.exception(
+                    "PDB %s budget check", pdb.key()
+                )
+                ok["granted"] = False
+            if ok.get("granted"):
+                granted.append(pdb)
+            else:
+                # deny -- and give back what this attempt already took
+                # from other matching PDBs, or a blocked pod would
+                # starve its siblings' budget
+                for g in granted:
+                    try:
+                        self.client.update_pdb_status(
+                            g.metadata.namespace, g.metadata.name,
+                            lambda p: setattr(
+                                p.status, "disruptions_allowed",
+                                p.status.disruptions_allowed + 1,
+                            ),
+                        )
+                    except Exception:  # noqa: BLE001 - best effort
+                        pass
+                metrics.evictions_blocked_by_pdb.inc()
+                return False
+        return True
+
+    def refund_disruption(self, pod: Pod) -> None:
+        """Give back the units a granted ``can_disrupt`` took when the
+        eviction itself then FAILED (delete error): without the refund a
+        crash-looping delete would drain the budget to zero with no pod
+        ever evicted, starving every other disruption path until the
+        reconcile loop happens to recompute."""
+        for pdb in self.pdbs_for_pod(pod):
+            try:
+                self.client.update_pdb_status(
+                    pdb.metadata.namespace, pdb.metadata.name,
+                    lambda p: setattr(
+                        p.status, "disruptions_allowed",
+                        p.status.disruptions_allowed + 1,
+                    ),
+                )
+            except Exception:  # noqa: BLE001 - best effort
+                pass
 
     # -- reconcile -----------------------------------------------------------
 

@@ -38,16 +38,24 @@ class NodeLifecycleController:
         grace_period: float = 40.0,
         monitor_interval: float = 5.0,
         now=time.time,
+        disruption=None,
     ) -> None:
+        """``disruption``: an optional DisruptionController whose
+        ``can_disrupt`` gate taint evictions share with node drains --
+        one PDB budget for EVERY voluntary disruption path, so a rolling
+        upgrade and an unreachable-node eviction can't independently
+        spend the same budget."""
         self.client = client
         self._nodes = informer_factory.nodes()
         self._pods = informer_factory.pods()
         self.grace_period = grace_period
         self.monitor_interval = monitor_interval
         self._now = now
+        self.disruption = disruption
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.evictions = 0
+        self.evictions_blocked = 0  # denied by the shared PDB gate
 
     # -- one monitor pass (monitorNodeHealth, :303) --------------------------
 
@@ -119,12 +127,22 @@ class NodeLifecycleController:
     def _evict_intolerant_pods(self, node_name: str) -> None:
         """NoExecute semantics: pods without a matching toleration are
         evicted (the NoExecuteTaintManager, zero toleration-seconds
-        model)."""
+        model) -- THROUGH the shared PDB gate when a
+        DisruptionController is wired: a taint eviction and a drain
+        spend the same ``can_disrupt`` budget, and a denied pod is
+        retried on the next monitor pass (the reconcile loop re-opens
+        the budget as earlier evictees terminate)."""
         taint = Taint(key=TAINT_UNREACHABLE, effect=TAINT_EFFECT_NO_EXECUTE)
         for pod in self._pods.list():
             if pod.spec.node_name != node_name:
                 continue
             if any(t.tolerates(taint) for t in pod.spec.tolerations):
+                continue
+            if (
+                self.disruption is not None
+                and not self.disruption.can_disrupt(pod)
+            ):
+                self.evictions_blocked += 1
                 continue
             try:
                 self.client.delete_pod(
@@ -132,9 +150,14 @@ class NodeLifecycleController:
                 )
                 self.evictions += 1
             except KeyError:
-                pass
+                pass  # already gone; reconcile recomputes from live pods
             except Exception:
                 logger.exception("evicting pod %s", pod.key())
+                if self.disruption is not None:
+                    # the grant was spent but nothing was evicted: give
+                    # the units back or a crash-looping delete drains
+                    # the budget to zero across every disruption path
+                    self.disruption.refund_disruption(pod)
 
     # -- loop ----------------------------------------------------------------
 
@@ -158,3 +181,110 @@ class NodeLifecycleController:
         if self._thread is not None:
             self._thread.join(timeout=2)
             self._thread = None
+
+
+class NodeDrainer:
+    """Cordon + PDB-gated eviction: the rolling node-upgrade primitive
+    (``kubectl drain`` semantics against this API surface, plus the
+    eviction REST handler's budget contention).
+
+    ``cordon`` flips ``spec.unschedulable`` -- the scheduler's
+    NodeUnschedulable filter (and the batch path's static mask) keeps
+    new pods off the node from the next snapshot. ``drain`` then evicts
+    every pod on the node through the SAME ``can_disrupt`` budget the
+    taint manager spends, retrying denied pods as the reconcile loop
+    re-opens the budget, until the node is empty or the deadline
+    passes. A drain that respects PDBs is therefore paced by the
+    evictees actually re-placing elsewhere -- exactly the coupling the
+    lifecycle-chaos wave exists to measure."""
+
+    def __init__(
+        self, client, disruption=None, poll: float = 0.02,
+        should_abort=None,
+    ) -> None:
+        """``should_abort``: optional nullary callable polled while a
+        drain waits on budget-blocked pods -- lets a harness tear down a
+        long drain instead of waiting out the deadline."""
+        self.client = client
+        self.disruption = disruption
+        self.poll = poll
+        self.should_abort = should_abort or (lambda: False)
+        self.evictions = 0
+        self.evictions_blocked = 0
+        self.drains = 0
+
+    def _set_unschedulable(self, node_name: str, value: bool) -> bool:
+        def mutate(node: Node) -> None:
+            node.spec.unschedulable = value
+
+        try:
+            self.client.server.guaranteed_update(
+                "Node", "", node_name, mutate
+            )
+            return True
+        except KeyError:
+            return False
+
+    def cordon(self, node_name: str) -> bool:
+        return self._set_unschedulable(node_name, True)
+
+    def uncordon(self, node_name: str) -> bool:
+        return self._set_unschedulable(node_name, False)
+
+    def _pods_on(self, node_name: str):
+        pods, _rv = self.client.list_pods()
+        return [
+            p for p in pods
+            if p.spec.node_name == node_name
+            and p.metadata.deletion_timestamp is None
+        ]
+
+    def drain(
+        self, node_name: str, timeout: float = 30.0, cordon: bool = True
+    ) -> bool:
+        """Returns True when the node emptied within the deadline; False
+        leaves the node cordoned with the stragglers still running
+        (their PDBs would not release them -- exactly what a real drain
+        reports back to the operator)."""
+        if cordon and not self.cordon(node_name):
+            return False
+        deadline = time.monotonic() + timeout
+        blocked_prev: set = set()
+        while True:
+            remaining = self._pods_on(node_name)
+            if not remaining:
+                self.drains += 1
+                return True
+            progressed = False
+            blocked_now: set = set()
+            for pod in remaining:
+                if (
+                    self.disruption is not None
+                    and not self.disruption.can_disrupt(pod)
+                ):
+                    if pod.metadata.uid not in blocked_prev:
+                        self.evictions_blocked += 1
+                    blocked_now.add(pod.metadata.uid)
+                    continue
+                try:
+                    self.client.delete_pod(
+                        pod.metadata.namespace, pod.metadata.name
+                    )
+                    self.evictions += 1
+                    progressed = True
+                except KeyError:
+                    progressed = True  # already gone
+                except Exception:
+                    logger.exception("draining pod %s", pod.key())
+                    if self.disruption is not None:
+                        # spent grant, no eviction: refund, or the
+                        # retry loop bleeds the budget dry
+                        self.disruption.refund_disruption(pod)
+            blocked_prev = blocked_now
+            if time.monotonic() >= deadline or self.should_abort():
+                return False
+            if not progressed:
+                # everything left is budget-blocked: wait for earlier
+                # evictees to terminate/re-place and the reconcile loop
+                # to re-open the budget
+                time.sleep(self.poll)

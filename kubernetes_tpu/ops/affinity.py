@@ -181,6 +181,7 @@ def pack_affinity_batch(
     keys/rows/values) -- the caller falls back to the host path."""
     b = len(pods)
     infos = snapshot.list_node_infos()
+    node_rows = nt.rows_for(infos).tolist()
     n_cap = nt.capacity
 
     v_cap = value_capacity(n_cap)
@@ -310,7 +311,7 @@ def pack_affinity_batch(
     node_value = np.full((MAX_KEYS, n_cap), -1, dtype=np.int32)
     for key, k in keys.items():
         ids = value_ids[k]
-        for j, ni in enumerate(infos):
+        for j, ni in zip(node_rows, infos):
             node = ni.node
             if node is None:
                 continue
@@ -332,7 +333,7 @@ def pack_affinity_batch(
 
     # exist rows: one bump per (existing pod, term) at the pod's node value
     # (filtering.go:212; the batch pods' own rows start at zero)
-    node_row_of = {ni.node_name: j for j, ni in enumerate(infos)}
+    node_row_of = {ni.node_name: j for j, ni in zip(node_rows, infos)}
     for e, t, r in existing_with_anti:
         j = node_row_of.get(e.spec.node_name)
         if j is None:
@@ -346,7 +347,7 @@ def pack_affinity_batch(
     # any single-term match (filtering.go:153)
     if aff_rows or anti_rows:
         group_rows = [rows for (_gid, rows) in aff_groups.values()]
-        for j, ni in enumerate(infos):
+        for j, ni in zip(node_rows, infos):
             if ni.node is None:
                 continue
             for e in ni.pods:
@@ -480,7 +481,7 @@ def add_host_port_rows(
     if key_free is None:
         return None  # no key slot left: host path
     infos = snapshot.list_node_infos()
-    for j, ni in enumerate(infos):
+    for j, ni in zip(nt.rows_for(infos).tolist(), infos):
         if ni.node is not None and j < n_cap:
             af.node_value[key_free, j] = j
 

@@ -407,6 +407,12 @@ def _solve_packed_jit(
         nzr_state = nzr_state.at[didx].set(arrs["dnzr"], mode="drop")
     if "sidx" in arrs:
         alloc = alloc.at[arrs["sidx"]].set(arrs["salloc"], mode="drop")
+        if "svalid" in arrs:
+            # membership churn: retired/claimed row slots also flip the
+            # resident valid mask (padding slots carry index >= N, drop)
+            valid = valid.at[arrs["sidx"]].set(
+                arrs["svalid"].astype(bool), mode="drop"
+            )
     pod_req = arrs["req"]
     pod_nzr_ = arrs["nzr"]
     midx = arrs["midx"]

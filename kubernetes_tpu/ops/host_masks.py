@@ -108,6 +108,7 @@ def static_mask_compact(
     O(B x N) to O(U x N + B), which matters when every transfer pays a
     tunnel round trip."""
     infos = snapshot.list_node_infos()
+    node_rows = nt.rows_for(infos).tolist()
     index = np.zeros(len(pods), dtype=np.int32)
     cache: Dict[Tuple, int] = {}
     rows: List[np.ndarray] = []
@@ -116,9 +117,7 @@ def static_mask_compact(
         u = cache.get(sig)
         if u is None:
             row = np.zeros(nt.capacity, dtype=bool)
-            # snapshot order == tensor row order (NodeTensorCache packs
-            # rows from the same list)
-            for j, ni in enumerate(infos):
+            for j, ni in zip(node_rows, infos):
                 node = ni.node
                 if node is None:
                     continue

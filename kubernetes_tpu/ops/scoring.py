@@ -300,6 +300,7 @@ def pack_score_batch(
     this batch (the common fast path); raises ScoreEnvelopeExceeded when
     the batch needs the host path."""
     infos = snapshot.list_node_infos()
+    node_rows = nt.rows_for(infos).tolist()
     n_cap = nt.capacity
     b = len(pods)
 
@@ -402,7 +403,7 @@ def pack_score_batch(
             )
             else []
         )
-        for j, ni in enumerate(infos):
+        for j, ni in zip(node_rows, infos):
             node = ni.node
             if node is None:
                 continue
@@ -455,7 +456,7 @@ def pack_score_batch(
     # ---- zones ------------------------------------------------------------
     zone_ids: Dict[str, int] = {}
     zone_id = np.full(n_cap, -1, dtype=np.int32)
-    for j, ni in enumerate(infos):
+    for j, ni in zip(node_rows, infos):
         zk = get_zone_key(ni.node)
         if not zk:
             continue
@@ -490,7 +491,7 @@ def pack_score_batch(
                 group_selectors.append((pods[i].metadata.namespace, cs))
             pod_sel_group[i] = g
         for g, (ns, cs) in enumerate(group_selectors):
-            for j, ni in enumerate(infos):
+            for j, ni in zip(node_rows, infos):
                 count = 0
                 for p in ni.pods:
                     if (
@@ -540,7 +541,7 @@ def pack_score_batch(
                 pod_soft_groups[i, ci] = g
         for g, (ns, key, sel) in enumerate(soft_specs):
             value_ids: Dict[str, int] = {}
-            for j, ni in enumerate(infos):
+            for j, ni in zip(node_rows, infos):
                 node = ni.node
                 if node is None:
                     continue
@@ -613,7 +614,7 @@ def pack_score_batch(
                 )
                 ids: Dict[str, int] = {}
                 row_value_ids.append(ids)
-                for j, ni in enumerate(infos):
+                for j, ni in zip(node_rows, infos):
                     node = ni.node
                     if node is None:
                         continue
@@ -660,7 +661,7 @@ def pack_score_batch(
                 pod_ipa_bump[i, r] += wgt
 
         node_of_pod = {}
-        for j, ni in enumerate(infos):
+        for j, ni in zip(node_rows, infos):
             for e in ni.pods:
                 node_of_pod[id(e)] = j
 
@@ -681,7 +682,7 @@ def pack_score_batch(
 
         # family-a counts: matching EXISTING pods per row per value, and
         # the per-pod match matrix (count replay + family-c gather)
-        for j, ni in enumerate(infos):
+        for j, ni in zip(node_rows, infos):
             if ni.node is None:
                 continue
             for e in ni.pods:

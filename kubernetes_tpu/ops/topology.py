@@ -127,6 +127,7 @@ def pack_spread_batch(
     pod_self = np.zeros((b, MAX_CONSTRAINTS_PER_POD), dtype=np.int32)
 
     infos = snapshot.list_node_infos()
+    node_rows = nt.rows_for(infos).tolist()
     # Per-key "some node lacks it" cache: reference pair counting
     # (common.go nodeLabelsMatchSpreadConstraints) excludes a node from
     # ALL of a pod's constraints when it lacks ANY constraint key. Shared
@@ -211,7 +212,7 @@ def pack_spread_batch(
     for g, (ns, key, sel, rep) in enumerate(specs):
         scoped = bool(_eligibility_sig(rep) != ((), ()))
         value_ids: Dict[str, int] = {}
-        for j, ni in enumerate(infos):
+        for j, ni in zip(node_rows, infos):
             node = ni.node
             if node is None:
                 continue

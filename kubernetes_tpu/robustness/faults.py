@@ -52,11 +52,20 @@ class FaultPoint:
     #: the watch replay window no longer covers since_rv (410 Gone
     #: analogue; the informer must relist + diff)
     WATCH_HISTORY_TRUNCATED = "watch_history_truncated"
+    #: one node flaps: deleted (spot kill / crash) and replaced by a
+    #: COLD node of the same name after a short down time. Evaluated
+    #: per tick by robustness/lifecycle.ClusterLifecycleDriver, which
+    #: performs the actual apiserver surgery.
+    NODE_FLAP = "node_flap"
+    #: spot-reclamation storm: a whole slice of the fleet is deleted at
+    #: once (mass requeue + re-solve), cold replacements join later
+    RECLAIM_STORM = "reclaim_storm"
 
     ALL = (
         DEVICE_SOLVE, DEVICE_SOLVE_HANG, SOLVE_GARBAGE, BIND_CONFLICT,
         WATCH_DROP, LEASE_RENEW_FAIL, API_UNAVAILABLE,
         CRASH_BETWEEN_ASSUME_AND_BIND, WATCH_HISTORY_TRUNCATED,
+        NODE_FLAP, RECLAIM_STORM,
     )
 
 
@@ -241,6 +250,30 @@ def builtin_profiles() -> Dict[str, FaultProfile]:
             name="flaky-watch",
             seed=0,
             points={FaultPoint.WATCH_DROP: PointConfig(rate=0.05)},
+        ),
+        # cluster-lifecycle chaos (PR-6 acceptance shape): node flaps +
+        # one spot-reclamation storm + a solver-fault sprinkle, so the
+        # ladder/breakers (PR 1), the sweeper/reconciler (PR 2), AND the
+        # slot-based device carry (PR 6) are exercised under membership
+        # churn at once. The flap/storm points are evaluated per
+        # ClusterLifecycleDriver tick; every point heals after a bounded
+        # number of fires so the run converges.
+        "lifecycle-chaos": FaultProfile(
+            name="lifecycle-chaos",
+            seed=0,
+            points={
+                FaultPoint.NODE_FLAP: PointConfig(rate=0.25, max_fires=8),
+                FaultPoint.RECLAIM_STORM: PointConfig(
+                    rate=0.08, max_fires=1
+                ),
+                FaultPoint.DEVICE_SOLVE: PointConfig(
+                    rate=0.05, max_fires=4
+                ),
+                # ONE conflict: absorbed by the default 2-attempt bind
+                # retry (2 fires would go terminal and the run measures
+                # the requeue flush interval, not the chaos)
+                FaultPoint.BIND_CONFLICT: PointConfig(rate=1.0, max_fires=1),
+            },
         ),
         # control-plane chaos (PR-2 acceptance shape): renew failures
         # that force a failover, transient API unavailability absorbed

@@ -216,7 +216,7 @@ _SHADOW_RING_CAP = MAX_INFLIGHT + 2
 
 def _delta_slot_pieces(
     n_cap, r_dims, fix_rows=None, alloc_rows=None,
-    node_requested=None, node_nzr=None, allocatable=None,
+    node_requested=None, node_nzr=None, allocatable=None, valid=None,
 ):
     """The fixed `DELTA_ROW_BUCKET`-sized (indices, rows) scatter slots
     every steady-state dispatch carries in the single upload buffer.
@@ -225,12 +225,18 @@ def _delta_slot_pieces(
     through this one helper or they fork a second signature and the
     first production batch pays the compile the warmup was built to
     prevent. Empty slots carry index ``n_cap`` (out of bounds) and drop
-    on device."""
+    on device.
+
+    ``svalid`` rides with the alloc scatter: membership churn retires /
+    claims row slots in place, so the patched rows must also flip the
+    device-resident valid mask (a retired slot with alloc zeroed is
+    still choosable by a zero-request pod unless valid drops)."""
     didx = np.full(DELTA_ROW_BUCKET, n_cap, dtype=np.int32)
     dreq = np.zeros((DELTA_ROW_BUCKET, r_dims), dtype=np.int32)
     dnzr = np.zeros((DELTA_ROW_BUCKET, 2), dtype=np.int32)
     sidx = np.full(DELTA_ROW_BUCKET, n_cap, dtype=np.int32)
     salloc = np.zeros((DELTA_ROW_BUCKET, r_dims), dtype=np.int32)
+    svalid = np.zeros(DELTA_ROW_BUCKET, dtype=np.int32)
     if fix_rows is not None and fix_rows.size:
         didx[: fix_rows.size] = fix_rows
         dreq[: fix_rows.size] = node_requested[fix_rows]
@@ -238,9 +244,10 @@ def _delta_slot_pieces(
     if alloc_rows is not None and alloc_rows.size:
         sidx[: alloc_rows.size] = alloc_rows
         salloc[: alloc_rows.size] = allocatable[alloc_rows]
+        svalid[: alloc_rows.size] = valid[alloc_rows]
     return [
         ("didx", didx), ("dreq", dreq), ("dnzr", dnzr),
-        ("sidx", sidx), ("salloc", salloc),
+        ("sidx", sidx), ("salloc", salloc), ("svalid", svalid),
     ]
 
 
@@ -356,6 +363,10 @@ class BatchScheduler(Scheduler):
         # mirrored placements -- node churn, bind failures)
         self.delta_rows_uploaded = 0
         self.carry_divergences = 0
+        # membership churn absorbed as in-place slot scatters (node
+        # add/remove rows patched onto the resident state without a
+        # layout move, an upload, or a divergence)
+        self.membership_row_patches = 0
         self._dev = _DeviceNodeState()
         self._shadow_lock = threading.Lock()
         # pipelined batches flow dispatcher -> committer through this
@@ -989,6 +1000,43 @@ class BatchScheduler(Scheduler):
         )
         return False, div_rows, (None if lagging else 0)
 
+    def _adopt_membership_rows(self, member, host_req, host_nzr):
+        """Under ``_shadow_lock``, with nothing in flight (so the device
+        carry equals the shadow): adopt host truth for churned row slots
+        into the shadow expectation and scrub them from the pending
+        ring (their pre-churn deltas can never be confirmed -- the slot
+        belongs to a different node now). Returns the subset whose
+        device content (== pre-adoption shadow) actually differs and
+        therefore must ride the didx scatter."""
+        ds = self._dev
+        diff = ~(
+            np.all(ds.req_shadow[member] == host_req[member], axis=1)
+            & np.all(ds.nzr_shadow[member] == host_nzr[member], axis=1)
+        )
+        fix = member[diff]
+        ds.req_shadow[member] = host_req[member]
+        ds.nzr_shadow[member] = host_nzr[member]
+        if ds.pending_deltas:
+            mset = set(member.tolist())
+            scrubbed = collections.deque(
+                maxlen=ds.pending_deltas.maxlen
+            )
+            for rows, req_rows, nzr_rows in ds.pending_deltas:
+                keepm = np.fromiter(
+                    (int(r) not in mset for r in rows),
+                    dtype=bool, count=len(rows),
+                )
+                if keepm.all():
+                    scrubbed.append((rows, req_rows, nzr_rows))
+                elif keepm.any():
+                    scrubbed.append(
+                        (rows[keepm], req_rows[keepm], nzr_rows[keepm])
+                    )
+                # entries fully on churned slots drop: nothing left to
+                # confirm
+            ds.pending_deltas = scrubbed
+        return fix
+
     def _negotiate_device_state(
         self, nt, node_requested, node_nzr, overlaid,
         allow_scatter, pending_exists,
@@ -996,13 +1044,17 @@ class BatchScheduler(Scheduler):
         """Decide how this dispatch's node state reaches the device and
         reconcile the handshake bookkeeping. Returns None when in-flight
         batches block the decision (caller drains and redispatches), else
-        ``{"static_ok", "carry_ok", "didx", "sidx"}``:
+        ``{"static_ok", "carry_ok", "didx", "sidx", "member"}``:
 
         - carry_ok + empty deltas: pure reuse, nothing node-sized rides
           the link.
         - carry_ok + didx/sidx rows: reuse, with externally changed rows
           (divergences / allocatable updates) patched onto the resident
-          state by the in-buffer scatter (ops/assignment.py).
+          state by the in-buffer scatter (ops/assignment.py). Membership
+          churn (node add/remove claiming/retiring slots in place, see
+          NodeTensorCache) rides the same scatter -- sidx patches alloc
+          AND valid, didx resets the slot's requested state -- and is an
+          EXPECTED reset, never counted as a divergence.
         - not carry_ok: full [N, R] requested upload (``state_uploads``);
           not static_ok additionally re-uploads allocatable+valid. The
           mesh path passes ``allow_scatter=False`` and always resolves
@@ -1020,6 +1072,8 @@ class BatchScheduler(Scheduler):
                 and ds.alloc_shadow.shape == nt.allocatable.shape
             )
             alloc_rows = empty
+            member = empty
+            member_fix = empty
             carry = "dead"
             div_rows = None
             keep = 0
@@ -1027,22 +1081,47 @@ class BatchScheduler(Scheduler):
                 changed = self.tensor_cache.rows_changed_since(
                     ds.validated_epoch
                 )
-                if changed.size:
+                member = self.tensor_cache.membership_rows_since(
+                    ds.validated_epoch
+                )
+                if member.size and allow_scatter and pending_exists:
+                    # churned slots cannot be reconciled while batches
+                    # are in flight: a pending batch may have placed
+                    # onto a now-retired slot, and adopting host truth
+                    # under it would desync the mirror. Land everything,
+                    # then redo the dispatch (the scatter then applies
+                    # cleanly -- no upload, no divergence).
+                    return None
+                nonmember = changed
+                if member.size:
+                    nonmember = np.setdiff1d(changed, member)
+                if nonmember.size:
                     diff = ~np.all(
-                        nt.allocatable[changed]
-                        == ds.alloc_shadow[changed],
+                        nt.allocatable[nonmember]
+                        == ds.alloc_shadow[nonmember],
                         axis=1,
                     )
-                    alloc_rows = changed[diff]
+                    alloc_rows = nonmember[diff]
+                if member.size:
+                    # membership rows always ride the static scatter:
+                    # alloc content AND validity flip with slot identity
+                    alloc_rows = np.union1d(alloc_rows, member)
                 if (
                     not overlaid
                     and ds.req_dev is not None
                     and ds.req_shadow is not None
                 ):
-                    ok, div_rows, keep = self._explain_rows(
-                        changed, node_requested, node_nzr
-                    )
-                    carry = "reuse" if ok else "diverged"
+                    if member.size and not allow_scatter:
+                        carry = "dead"  # mesh: counted full upload
+                    else:
+                        if member.size:
+                            member_fix = self._adopt_membership_rows(
+                                member, node_requested, node_nzr
+                            )
+                        ok, div_rows, keep = self._explain_rows(
+                            nonmember, node_requested, node_nzr
+                        )
+                        carry = "reuse" if ok else "diverged"
             static_full = (
                 not layout_ok
                 or alloc_rows.size > DELTA_ROW_BUCKET
@@ -1064,6 +1143,17 @@ class BatchScheduler(Scheduler):
                     fix_rows = div_rows
                 else:
                     carry = "dead"  # resolve by full upload (or drain)
+            didx_rows = member_fix
+            if fix_rows.size:
+                didx_rows = np.union1d(member_fix, fix_rows)
+            if didx_rows.size > DELTA_ROW_BUCKET:
+                # too many row patches: full upload. `diverged` keeps
+                # its value -- a genuine divergence resolved by this
+                # upload must still be counted, even when the overflow
+                # came from the membership rows
+                carry = "dead"
+                fix_rows = empty
+                didx_rows = empty
             reusable = not static_full and (
                 carry == "reuse" or fix_rows.size > 0
             )
@@ -1083,16 +1173,19 @@ class BatchScheduler(Scheduler):
                     ds.req_shadow[fix_rows] = node_requested[fix_rows]
                     ds.nzr_shadow[fix_rows] = node_nzr[fix_rows]
                     self.carry_divergences += 1
+                if member.size:
+                    self.membership_row_patches += int(member.size)
                 ds.validated_epoch = d.epoch
                 self.state_reuses += 1
                 self.delta_rows_uploaded += int(
-                    alloc_rows.size + fix_rows.size
+                    alloc_rows.size + didx_rows.size
                 )
                 return {
                     "static_ok": True,
                     "carry_ok": True,
-                    "didx": fix_rows,
+                    "didx": didx_rows,
                     "sidx": alloc_rows,
+                    "member": int(member.size),
                 }
             # upload path
             if diverged:
@@ -1113,6 +1206,7 @@ class BatchScheduler(Scheduler):
                 "carry_ok": False,
                 "didx": empty,
                 "sidx": empty,
+                "member": 0,
             }
 
     def _dispatch_solve(
@@ -1514,7 +1608,7 @@ class BatchScheduler(Scheduler):
                     nt.capacity, nt.dims.num_dims,
                     fix_rows=neg["didx"], alloc_rows=neg["sidx"],
                     node_requested=node_requested, node_nzr=node_nzr,
-                    allocatable=nt.allocatable,
+                    allocatable=nt.allocatable, valid=nt.valid,
                 )
             if constrained:
                 from kubernetes_tpu.ops.assignment import ConstPiece
@@ -1630,6 +1724,7 @@ class BatchScheduler(Scheduler):
                         self.delta_rows_uploaded -= int(
                             neg["didx"].size + neg["sidx"].size
                         )
+                        self.membership_row_patches -= neg["member"]
                     else:
                         self.state_uploads -= 1
                     if neg["sidx"].size or not static_ok:
@@ -1674,6 +1769,7 @@ class BatchScheduler(Scheduler):
                         self.delta_rows_uploaded -= int(
                             neg["didx"].size + neg["sidx"].size
                         )
+                        self.membership_row_patches -= neg["member"]
                     else:
                         self.state_uploads -= 1
                     if neg["sidx"].size or not static_ok:
@@ -1705,9 +1801,10 @@ class BatchScheduler(Scheduler):
                 if not static_ok:
                     ds.alloc_dev, ds.valid_dev = alloc_out, valid_out
                 elif neg["sidx"].size:
-                    # the in-buffer scatter patched the resident alloc;
-                    # keep the patched ref
-                    ds.alloc_dev = alloc_out
+                    # the in-buffer scatter patched the resident alloc
+                    # (and, for membership churn, the valid mask); keep
+                    # the patched refs
+                    ds.alloc_dev, ds.valid_dev = alloc_out, valid_out
                 try:
                     assignments_dev.copy_to_host_async()
                 except AttributeError:
@@ -2191,6 +2288,10 @@ class BatchScheduler(Scheduler):
 
         failed_group: List[Tuple[PodInfo, FitError]] = []
         cluster_anti = None
+        # live nodes only: with the slot layout, num_nodes counts free
+        # (retired) slots too, and the "0/N nodes are available" message
+        # must not claim more nodes than the cluster has
+        live_nodes = sum(1 for n in names if n)
         # statuses are a pure function of the (deduplicated) mask row:
         # identical unschedulable pods share one dict
         statuses_by_row: dict = {}
@@ -2253,9 +2354,12 @@ class BatchScheduler(Scheduler):
                             for j in np.flatnonzero(
                                 ~m_rows[ridx][:num_nodes]
                             )
+                            # free (retired) slots are masked off too
+                            # but are not nodes
+                            if names[int(j)]
                         }
                         statuses_by_row[ridx] = statuses
-                fit_err = FitError(pi.pod, num_nodes, statuses)
+                fit_err = FitError(pi.pod, live_nodes, statuses)
                 self.pods_solved_on_device += 1
                 # device-eligible failures preempt as ONE group (one
                 # device round trip via Preemptor.preempt_batch); the

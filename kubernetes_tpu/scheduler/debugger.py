@@ -92,18 +92,28 @@ class TensorComparer:
             topology_encoder=self.tensor_cache.topology,
         ).update(self.snapshot)
         problems = []
-        n = incremental.num_nodes
-        for field in ("allocatable", "requested", "non_zero_requested"):
-            a = getattr(incremental, field)[:n]
-            b = getattr(fresh, field)[:n]
-            if not np.array_equal(a, b):
-                rows = np.where((a != b).any(axis=1))[0]
-                problems.append(
-                    f"{field} mismatch on rows "
-                    f"{[incremental.names[r] for r in rows[:5]]}"
-                )
-        if incremental.names != fresh.names:
-            problems.append("node name order mismatch")
+        # compare per NAME: the incremental tensor's slot layout (free
+        # rows, claimed headroom) legitimately orders rows differently
+        # from a from-scratch pack of the same snapshot
+        live = sorted(n for n in incremental.names if n)
+        if live != sorted(fresh.names):
+            problems.append("node membership mismatch")
+        else:
+            inc_rows = np.asarray(
+                [incremental.row(n) for n in live], dtype=np.int64
+            )
+            fr_rows = np.asarray(
+                [fresh.row(n) for n in live], dtype=np.int64
+            )
+            for field in ("allocatable", "requested", "non_zero_requested"):
+                a = getattr(incremental, field)[inc_rows]
+                b = getattr(fresh, field)[fr_rows]
+                if not np.array_equal(a, b):
+                    rows = np.where((a != b).any(axis=1))[0]
+                    problems.append(
+                        f"{field} mismatch on rows "
+                        f"{[live[r] for r in rows[:5]]}"
+                    )
         for p in problems:
             logger.warning("tensor comparer: %s", p)
         return problems

@@ -172,11 +172,18 @@ class ControlPlaneReconciler:
         was lost) -> re-adopt; deleted -> nothing to do."""
         expired = self.sched.cache.cleanup_expired_assumed_pods()
         for pod in expired:
+            node_removed = pod.__dict__.pop("_node_removed_expired", False)
             metrics.assumed_pods_expired.inc()
-            logger.warning(
-                "assumed pod %s expired (binding finished, confirmation "
-                "never arrived)", pod.key(),
-            )
+            if node_removed:
+                logger.warning(
+                    "assumed pod %s fast-expired (node %s deleted "
+                    "mid-bind)", pod.key(), pod.spec.node_name,
+                )
+            else:
+                logger.warning(
+                    "assumed pod %s expired (binding finished, "
+                    "confirmation never arrived)", pod.key(),
+                )
             try:
                 live = self.client.get_pod(
                     pod.metadata.namespace, pod.metadata.name
@@ -193,6 +200,8 @@ class ControlPlaneReconciler:
                     metrics.cache_drift.inc(kind="pod", action="readopt")
                 else:
                     self.sched.queue.add(live)
+                    if node_removed:
+                        metrics.node_removed_requeues.inc()
             except Exception:
                 logger.exception("routing expired pod %s", pod.key())
         return expired

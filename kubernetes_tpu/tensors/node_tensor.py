@@ -24,6 +24,7 @@ solver JITs once per bucket, not per node-count (SURVEY.md section 7
 
 from __future__ import annotations
 
+import heapq
 import math
 import threading
 from dataclasses import dataclass, field
@@ -50,6 +51,14 @@ from kubernetes_tpu.cache.snapshot import Snapshot
 from kubernetes_tpu.tensors.encoding import TopologyEncoder
 
 NODE_BUCKET = 128  # row padding granularity (TPU lane width)
+
+#: extra row slots allocated past the live node count so membership
+#: churn (autoscaler adds, spot replacements) claims pre-zeroed rows
+#: instead of forcing a full repack + re-upload: max(NODE_BUCKET/2,
+#: n/8) before bucket rounding, so a 5k-node cluster absorbs ~600 net
+#: adds and a small cluster a full bucket before the layout moves
+def _row_headroom(n: int) -> int:
+    return max(NODE_BUCKET // 2, n // 8)
 
 CPU, MEM, EPH, PODS = 0, 1, 2, 3
 NUM_FIXED_DIMS = 4
@@ -199,31 +208,46 @@ class TensorDelta:
 
     ``epoch`` is the cache's monotonic update counter after this update;
     every row repacked here carries it in the per-row epoch array (see
-    ``rows_changed_since``). ``layout_epoch`` moves whenever row IDENTITY
-    moved -- membership add/remove, order remap, schema growth, capacity
-    growth -- i.e. whenever a device buffer built against the previous
-    layout can no longer be patched row-wise and must be re-uploaded."""
+    ``rows_changed_since``). ``layout_epoch`` moves only when existing
+    row identity can no longer be patched row-wise -- schema growth or
+    slot-capacity exhaustion (full repack). Pure membership add/remove
+    claims/retires SLOTS in place: the affected rows land in
+    ``membership_rows`` (and ``changed_rows``) so device-state consumers
+    patch them as O(changed) scatters instead of re-uploading [N, R]."""
 
     epoch: int
     layout_epoch: int
     changed_rows: np.ndarray  # int64 row indices repacked by THIS update
     full: bool  # True when every row was repacked (layout moved)
+    # row slots whose IDENTITY changed this update (node added into the
+    # slot, or the slot's node retired): expected resets for the device
+    # handshake, never divergences
+    membership_rows: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, dtype=np.int64)
+    )
 
 
 @dataclass
 class NodeTensor:
-    """The packed view handed to the solver. Rows [num_nodes:] are padding
-    (allocatable all-zero => infeasible for any non-zero request; the
-    ``valid`` mask guards zero-request pods)."""
+    """The packed view handed to the solver. Rows are SLOTS: a retired
+    node's slot stays in place (zeroed, ``valid`` False, name ``""``)
+    until a later add reclaims it, so membership churn never moves the
+    surviving rows. Rows [num_nodes:] are capacity padding; both padding
+    and free slots are infeasible for any non-zero request (allocatable
+    all-zero) and masked off for zero-request pods by ``valid``."""
 
-    names: List[str]
+    names: List[str]  # slot -> node name; "" marks a free (retired) slot
     allocatable: np.ndarray  # [N, R] int32
     requested: np.ndarray  # [N, R] int32 (col PODS = current pod count)
     non_zero_requested: np.ndarray  # [N, 2] int32 (milliCPU, KiB)
-    valid: np.ndarray  # [N] bool
+    valid: np.ndarray  # [N] bool (occupied slots only)
     topology: np.ndarray  # [N, K] int32 interned topology values
     dims: ResourceDims
     topology_encoder: TopologyEncoder
+    #: tensor row per entry of the snapshot's node_info_list: packers
+    #: iterating the snapshot MUST index node-dimension tensors through
+    #: this (snapshot order stopped being row order when slots arrived)
+    info_rows: Optional[np.ndarray] = field(default=None, repr=False)
     _row_of: Optional[Dict[str, int]] = field(default=None, repr=False)
     delta: Optional[TensorDelta] = field(default=None, repr=False)
 
@@ -233,20 +257,40 @@ class NodeTensor:
 
     @property
     def num_nodes(self) -> int:
+        """Slot count (the indexable prefix of ``names``): >= the live
+        node count whenever retired slots exist."""
         return len(self.names)
 
     def row(self, name: str) -> int:
         if self._row_of is None:
-            self._row_of = {n: i for i, n in enumerate(self.names)}
+            self._row_of = {
+                n: i for i, n in enumerate(self.names) if n
+            }
         return self._row_of[name]
+
+    def rows_for(self, infos: List[NodeInfo]) -> np.ndarray:
+        """Tensor row per entry of ``infos`` (the snapshot's
+        node_info_list, the order every packer iterates in). Packers MUST
+        index node-dimension tensors through this: with the slot layout,
+        snapshot position j and tensor row diverge as soon as one
+        membership change lands. Falls back to the identity map for
+        tensors built without a row map (direct construction in
+        tests/tools, where no slots have ever moved)."""
+        if self.info_rows is not None and len(self.info_rows) == len(infos):
+            return self.info_rows
+        return np.arange(len(infos), dtype=np.int64)
 
 
 class NodeTensorCache:
     """Incremental Snapshot -> NodeTensor packer.
 
     Mirrors cache.UpdateSnapshot's generation compare (cache.go:239): a row
-    is repacked only when its NodeInfo.generation moved. Node add/remove
-    and resource/topology schema growth trigger a full repack."""
+    is repacked only when its NodeInfo.generation moved. Rows are SLOTS
+    with pre-allocated headroom and a free-row list: node add/remove
+    claims or retires a slot in place -- O(changed rows), no layout move
+    -- and a pure ordering change is a no-op. A full repack (counted,
+    layout_epoch bump) happens only for resource/topology schema growth
+    or when adds exhaust the slot headroom."""
 
     def __init__(
         self,
@@ -257,22 +301,34 @@ class NodeTensorCache:
         self.topology = topology_encoder or TopologyEncoder()
         self._row_of: Dict[str, int] = {}
         self._generations: List[int] = []
-        self._names: List[str] = []
+        self._names: List[str] = []  # slot -> name, "" = free slot
+        self._free_rows: List[int] = []  # min-heap of retired slots
+        self._node_count = 0
         self._alloc = np.zeros((0, self.dims.num_dims), dtype=np.int32)
         self._req = np.zeros((0, self.dims.num_dims), dtype=np.int32)
         self._nzr = np.zeros((0, 2), dtype=np.int32)
         self._topo = np.zeros((0, 0), dtype=np.int32)
+        self._occupied = np.zeros(0, dtype=bool)
         self._dims_version = self.dims.version
         self._topo_version = self.topology.version
         self.full_repacks = 0
         self.rows_repacked = 0
-        self.reorders = 0  # pure order remaps (no repack of unmoved rows)
+        self.rows_added = 0  # slots claimed by incremental node adds
+        self.rows_retired = 0  # slots freed by incremental node removals
+        self.reorders = 0  # ordering-only snapshot changes (zero work now)
         # monotonic update epoch: every repacked row is stamped with the
         # epoch of the update that repacked it, so device-state consumers
-        # reconcile via rows_changed_since(epoch) instead of re-diffing
+        # reconcile via rows_changed_since(epoch) instead of re-diffing;
+        # membership (identity) changes additionally stamp the member
+        # epoch so the handshake can tell expected slot resets apart
+        # from divergences
         self._epoch = 0
         self._layout_epoch = 0
         self._row_epoch = np.zeros(0, dtype=np.int64)
+        self._row_member_epoch = np.zeros(0, dtype=np.int64)
+        # snapshot-position -> tensor row map handed to the packers via
+        # NodeTensor.info_rows; rebuilt only when membership/order moved
+        self._info_rows: Optional[np.ndarray] = None
         # change-tracking baseline: the snapshot whose change log we
         # follow and our private read cursor into it (O(changed) update
         # fast path; reads are cursor-based and never mutate the log, so
@@ -305,17 +361,52 @@ class NodeTensorCache:
                 ni.node.metadata.labels if ni.node else {}
             )
         self._generations[i] = ni.generation
+        self._occupied[i] = True
         self._row_epoch[i] = self._epoch
 
     def _grow(self, n: int) -> None:
-        cap = max(NODE_BUCKET, NODE_BUCKET * math.ceil(n / NODE_BUCKET))
+        target = max(n + _row_headroom(n), NODE_BUCKET)
+        cap = NODE_BUCKET * math.ceil(target / NODE_BUCKET)
         r = self.dims.num_dims
         k = len(self.topology.keys)
         self._alloc = np.zeros((cap, r), dtype=np.int32)
         self._req = np.zeros((cap, r), dtype=np.int32)
         self._nzr = np.zeros((cap, 2), dtype=np.int32)
         self._topo = np.zeros((cap, k), dtype=np.int32)
+        self._occupied = np.zeros(cap, dtype=bool)
         self._row_epoch = np.zeros(cap, dtype=np.int64)
+        self._row_member_epoch = np.zeros(cap, dtype=np.int64)
+
+    # -- slot lifecycle (incremental membership) -----------------------------
+
+    def _retire_row(self, i: int) -> None:
+        """Free an occupied slot in place: zero its content (free slots
+        must be infeasible exactly like capacity padding), stamp both
+        epochs, and put it on the free list for the next add."""
+        self._alloc[i] = 0
+        self._req[i] = 0
+        self._nzr[i] = 0
+        if self._topo.shape[1]:
+            self._topo[i] = 0
+        self._generations[i] = 0
+        self._occupied[i] = False
+        self._row_epoch[i] = self._epoch
+        self._row_member_epoch[i] = self._epoch
+        heapq.heappush(self._free_rows, i)
+        self.rows_retired += 1
+
+    def _claim_row(self) -> Optional[int]:
+        """A slot for a new node: lowest free slot first, else the next
+        never-used slot inside the allocated capacity. None = headroom
+        exhausted (caller must full-repack with fresh headroom)."""
+        if self._free_rows:
+            return heapq.heappop(self._free_rows)
+        i = len(self._names)
+        if i >= self._alloc.shape[0]:
+            return None
+        self._names.append("")
+        self._generations.append(0)
+        return i
 
     # -- epoch handshake support --------------------------------------------
 
@@ -333,6 +424,18 @@ class NodeTensorCache:
         O(N) int compare -- never O(N*R) content work."""
         return np.flatnonzero(self._row_epoch[: len(self._names)] > epoch)
 
+    def membership_rows_since(self, epoch: int) -> np.ndarray:
+        """Row slots whose IDENTITY changed since ``epoch`` (a node was
+        added into the slot or retired from it), valid while
+        ``layout_epoch`` is unchanged. These are EXPECTED resets for the
+        device-state handshake: their host content legitimately differs
+        from the mirrored expectation and must be scatter-adopted, not
+        counted as divergence. Same O(N) int compare as
+        ``rows_changed_since``."""
+        return np.flatnonzero(
+            self._row_member_epoch[: len(self._names)] > epoch
+        )
+
     def _register_columns(self, ni: NodeInfo) -> None:
         dims = self.dims
         for name in ni.allocatable.scalar:
@@ -344,19 +447,26 @@ class NodeTensorCache:
         for name in ni.volume_in_use:
             dims.volume_column(name)
 
-    def _build_tensor(self, n: int, delta: TensorDelta) -> NodeTensor:
-        valid = np.zeros(self._alloc.shape[0], dtype=bool)
-        valid[:n] = True
+    def _build_tensor(self, delta: TensorDelta) -> NodeTensor:
         return NodeTensor(
             names=self._names,
             allocatable=self._alloc,
             requested=self._req,
             non_zero_requested=self._nzr,
-            valid=valid,
+            valid=self._occupied.copy(),
             topology=self._topo,
             dims=self.dims,
             topology_encoder=self.topology,
+            info_rows=self._info_rows,
             delta=delta,
+        )
+
+    def _refresh_info_rows(self, infos: List[NodeInfo]) -> None:
+        row_of = self._row_of
+        self._info_rows = np.fromiter(
+            (row_of[ni.node_name] for ni in infos),
+            dtype=np.int64,
+            count=len(infos),
         )
 
     # -- the update entry point --------------------------------------------
@@ -369,8 +479,10 @@ class NodeTensorCache:
         When the snapshot carries accumulated change notes (the
         scheduler's own snapshot, refreshed by ``cache.update_snapshot``),
         the update itself is O(changed): only the noted NodeInfos get the
-        generation compare. Foreign snapshots (tests, tools) take the
-        full generation walk -- same result, O(N) int compares."""
+        generation compare. Membership changes take an O(N) set diff and
+        touch only the affected slots (retire into the free list / claim
+        a free or headroom slot). Foreign snapshots (tests, tools) take
+        the full generation walk -- same result, O(N) int compares."""
         self._epoch += 1
         tracked = None
         membership_hint = True
@@ -380,19 +492,23 @@ class NodeTensorCache:
             )
         else:
             # new snapshot object: establish our cursor baseline and
-            # take the full walk once
+            # take the full walk once (no ordering signal to count)
             self._last_snapshot = snapshot
             self._change_cursor = snapshot.change_cursor()
+            membership_hint = False
         if (
             tracked is not None
             and not membership_hint
             and self._names
-            and len(self._names) == len(snapshot.node_info_list)
+            and self._node_count == len(snapshot.node_info_list)
         ):
             nt = self._update_tracked(snapshot, tracked)
             if nt is not None:
                 return nt
-        return self._update_full(snapshot)
+            tracked = None  # notes insufficient: full generation walk
+        elif not membership_hint:
+            tracked = None
+        return self._update_full(snapshot, tracked, membership_hint)
 
     def _update_tracked(
         self, snapshot: Snapshot, tracked
@@ -425,7 +541,6 @@ class NodeTensorCache:
                 changed_rows.append(i)
         changed_rows.sort()
         return self._build_tensor(
-            len(self._names),
             TensorDelta(
                 epoch=self._epoch,
                 layout_epoch=self._layout_epoch,
@@ -434,75 +549,125 @@ class NodeTensorCache:
             ),
         )
 
-    def _update_full(self, snapshot: Snapshot) -> NodeTensor:
+    def _update_full(
+        self, snapshot: Snapshot, tracked=None, membership_hint=True
+    ) -> NodeTensor:
+        """Membership diff + generation compare. ``tracked`` (when the
+        change log survived) limits the generation compare to the noted
+        names; None means compare every row."""
         infos = snapshot.list_node_infos()
-        names = [ni.node_name for ni in infos]
+        info_map = snapshot.node_info_map
         # Register scalar-resource columns BEFORE sizing arrays: packing a
         # row must never grow the schema mid-update.
-        for ni in infos:
-            self._register_columns(ni)
+        if tracked is None:
+            for ni in infos:
+                self._register_columns(ni)
+        else:
+            for name in tracked:
+                ni = info_map.get(name)
+                if ni is not None and ni.node is not None:
+                    self._register_columns(ni)
         schema_moved = (
             self.dims.version != self._dims_version
             or self.topology.version != self._topo_version
         )
-        membership_moved = names != self._names
-        if (
-            membership_moved
-            and not schema_moved
-            and len(names) == len(self._names)
-            and set(names) == set(self._names)
-        ):
-            # pure ordering change: permute the packed rows to the new
-            # order instead of repacking all of them, then fall through
-            # to the normal generation compare. Row identity moved, so
-            # the layout epoch bumps (device buffers must resync).
-            m = len(names)
-            perm = np.fromiter(
-                (self._row_of[n] for n in names), dtype=np.intp, count=m
-            )
-            self._alloc[:m] = self._alloc[perm]
-            self._req[:m] = self._req[perm]
-            self._nzr[:m] = self._nzr[perm]
-            self._topo[:m] = self._topo[perm]
-            gens = self._generations
-            self._generations = [gens[j] for j in perm]
-            self._row_epoch[:m] = self._row_epoch[perm]
-            self._names = list(names)
-            self._row_of = {n: i for i, n in enumerate(names)}
-            self._layout_epoch += 1
-            self.reorders += 1
-            membership_moved = False
-        full = False
-        if schema_moved or membership_moved or self._alloc.shape[0] < len(infos):
-            # full repack (node set or schema changed)
-            self._names = list(names)
-            self._row_of = {n: i for i, n in enumerate(names)}
+        names_now = [ni.node_name for ni in infos]
+        current = set(names_now)
+        removed = [n for n in self._row_of if n not in current]
+        added = [n for n in names_now if n not in self._row_of]
+        slots_available = (
+            len(self._free_rows)
+            + len(removed)
+            + (self._alloc.shape[0] - len(self._names))
+        )
+        if schema_moved or len(added) > slots_available:
+            # full repack: schema grew, or adds exhausted the slot
+            # headroom -- counted, layout moves, fresh headroom
+            self._names = list(names_now)
+            self._row_of = {n: i for i, n in enumerate(names_now)}
             self._generations = [0] * len(infos)
+            self._free_rows = []
+            self._node_count = len(infos)
             self._grow(len(infos))
             for i, ni in enumerate(infos):
                 self._pack_row(i, ni)
             self.full_repacks += 1
             self.rows_repacked += len(infos)
             self._layout_epoch += 1
-            full = True
-            changed_rows = np.arange(len(infos), dtype=np.int64)
-        else:
-            changed = []
-            for i, ni in enumerate(infos):
+            self._row_member_epoch[:] = self._epoch
+            self._refresh_info_rows(infos)
+            self._dims_version = self.dims.version
+            self._topo_version = self.topology.version
+            return self._build_tensor(
+                TensorDelta(
+                    epoch=self._epoch,
+                    layout_epoch=self._layout_epoch,
+                    changed_rows=np.arange(len(infos), dtype=np.int64),
+                    full=True,
+                ),
+            )
+        member_rows: List[int] = []
+        if removed or added:
+            # copy-on-write: NodeTensors captured by in-flight batches
+            # keep resolving assignment indices against the layout they
+            # were dispatched with
+            self._names = list(self._names)
+            for n in removed:
+                i = self._row_of.pop(n)
+                self._names[i] = ""
+                self._retire_row(i)
+                member_rows.append(i)
+            for n in added:
+                i = self._claim_row()
+                self._row_of[n] = i
+                self._names[i] = n
+                self._pack_row(i, info_map[n])
+                self._row_member_epoch[i] = self._epoch
+                self.rows_added += 1
+                self.rows_repacked += 1
+                member_rows.append(i)
+            self._node_count = len(infos)
+        elif membership_hint and self._info_rows is not None:
+            # ordering-only change: slots do not move, nothing repacks
+            self.reorders += 1
+        # snapshot positions may have shifted even without add/remove
+        # (ordering change) -- refresh the packers' position->row map on
+        # any full-path update (it is O(N) dict gets, and this path
+        # already walked the list)
+        self._refresh_info_rows(infos)
+        changed: List[int] = []
+        row_of = self._row_of
+        if tracked is None:
+            for ni in infos:
+                i = row_of[ni.node_name]
                 if self._generations[i] != ni.generation:
                     self._pack_row(i, ni)
                     self.rows_repacked += 1
                     changed.append(i)
-            changed_rows = np.asarray(changed, dtype=np.int64)
+        else:
+            for name in tracked:
+                ni = info_map.get(name)
+                i = row_of.get(name)
+                if ni is None or ni.node is None or i is None:
+                    continue  # removed this update: already retired
+                if self._generations[i] != ni.generation:
+                    self._pack_row(i, ni)
+                    self.rows_repacked += 1
+                    changed.append(i)
+        changed_rows = np.asarray(
+            sorted(changed + member_rows), dtype=np.int64
+        )
         self._dims_version = self.dims.version
         self._topo_version = self.topology.version
         return self._build_tensor(
-            len(infos),
             TensorDelta(
                 epoch=self._epoch,
                 layout_epoch=self._layout_epoch,
                 changed_rows=changed_rows,
-                full=full,
+                full=False,
+                membership_rows=np.asarray(
+                    sorted(member_rows), dtype=np.int64
+                ),
             ),
         )
 

@@ -313,6 +313,20 @@ assumed_pods_expired = registry.register(Counter(
     "Assumed pods expired by the TTL sweeper (binding finished but the "
     "watch confirmation never arrived).",
 ))
+# cluster-lifecycle wave (PR 6): drains, reclamation storms, and churn
+# must be as observable as any other rehearsed failure path
+evictions_blocked_by_pdb = registry.register(Counter(
+    "scheduler_evictions_blocked_by_pdb_total",
+    "Voluntary disruptions (drain or taint eviction) denied by the "
+    "shared PodDisruptionBudget gate (DisruptionController."
+    "can_disrupt).",
+))
+node_removed_requeues = registry.register(Counter(
+    "scheduler_node_removed_requeues_total",
+    "In-flight assumed pods whose node was deleted mid-bind, expired "
+    "immediately and routed by apiserver truth instead of waiting out "
+    "the assume TTL.",
+))
 cache_drift = registry.register(Counter(
     "scheduler_cache_drift_total",
     "Cache<->apiserver divergences detected and healed by the drift "

@@ -37,3 +37,11 @@ if "xla_force_host_platform_device_count" not in prev:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow'; the lifecycle storm e2es opt out
+    # of the tier-1 budget via this marker
+    config.addinivalue_line(
+        "markers", "slow: long-running e2e excluded from tier-1"
+    )

@@ -200,3 +200,91 @@ class TestStorageWakeups:
         informers.stop()
         assert counts["unschedulable"] == 0
         assert counts["active"] + counts["backoff"] == 1
+
+
+class TestCanDisrupt:
+    """The shared voluntary-disruption gate (PR 6): drains AND taint
+    evictions spend the same PDB budget through can_disrupt, which
+    check-and-decrements via guaranteed_update (eviction.go:141)."""
+
+    def _env(self):
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        ctrl = DisruptionController(client, informers)
+        return server, client, informers, ctrl
+
+    def test_no_matching_pdb_always_allows(self):
+        server, client, informers, ctrl = self._env()
+        pod = make_pod("free").labels(app="x").node("n").obj()
+        client.create_pod(pod)
+        informers.pods().pump()
+        assert ctrl.can_disrupt(pod)
+
+    def test_grant_consumes_budget_then_denies(self):
+        server, client, informers, ctrl = self._env()
+        client.create_pdb(_pdb("g", {"app": "web"}, min_available=2))
+        pods = []
+        for i in range(3):
+            p = make_pod(f"p{i}").labels(app="web").node("n").obj()
+            client.create_pod(p)
+            pods.append(p)
+        informers.pods().pump()
+        informers.pdbs().pump()
+        ctrl.sync_all()  # 3 healthy - 2 minAvailable = 1 allowed
+        from kubernetes_tpu.utils import metrics
+
+        blocked0 = metrics.evictions_blocked_by_pdb.value()
+        assert ctrl.can_disrupt(pods[0])  # spends the single unit
+        assert not ctrl.can_disrupt(pods[1])  # budget exhausted
+        pdbs, _ = client.list_pdbs()
+        assert pdbs[0].status.disruptions_allowed == 0
+        assert metrics.evictions_blocked_by_pdb.value() == blocked0 + 1
+
+    def test_budget_reopens_after_evictee_terminates(self):
+        server, client, informers, ctrl = self._env()
+        client.create_pdb(_pdb("g", {"app": "web"}, min_available=1))
+        pods = []
+        for i in range(2):
+            p = make_pod(f"p{i}").labels(app="web").node("n").obj()
+            client.create_pod(p)
+            pods.append(p)
+        informers.pods().pump()
+        informers.pdbs().pump()
+        ctrl.sync_all()
+        assert ctrl.can_disrupt(pods[0])
+        assert not ctrl.can_disrupt(pods[1])
+        # the evictee actually terminates; the reconcile loop recomputes
+        client.delete_pod("default", "p0")
+        # a replacement binds elsewhere, restoring healthy count
+        client.create_pod(
+            make_pod("p0r").labels(app="web").node("m").obj()
+        )
+        informers.pods().pump()
+        ctrl.sync_all()
+        assert ctrl.can_disrupt(pods[1])
+
+    def test_deny_refunds_sibling_pdbs(self):
+        """A pod under TWO PDBs where only one has budget: the deny
+        must refund the unit already taken from the granting sibling,
+        or a blocked pod would starve unrelated disruptions."""
+        server, client, informers, ctrl = self._env()
+        client.create_pdb(_pdb("rich", {"app": "web"}, max_unavailable=2))
+        client.create_pdb(_pdb("poor", {"tier": "gold"}, min_available=2))
+        p = (
+            make_pod("both").labels(app="web", tier="gold").node("n").obj()
+        )
+        client.create_pod(p)
+        client.create_pod(
+            make_pod("web2").labels(app="web").node("n").obj()
+        )
+        client.create_pod(
+            make_pod("gold2").labels(tier="gold").node("n").obj()
+        )
+        informers.pods().pump()
+        informers.pdbs().pump()
+        ctrl.sync_all()  # rich: allowed=2; poor: 2 healthy - 2 = 0
+        assert not ctrl.can_disrupt(p)
+        pdbs = {pdb.metadata.name: pdb for pdb in client.list_pdbs()[0]}
+        assert pdbs["rich"].status.disruptions_allowed == 2  # refunded
+        assert pdbs["poor"].status.disruptions_allowed == 0

@@ -66,6 +66,7 @@ def _prime(sched, nt):
         "carry_ok": False,
         "didx": neg["didx"],
         "sidx": neg["sidx"],
+        "member": 0,
     }
     ds = sched._dev
     ds.alloc_dev = object()
@@ -238,7 +239,10 @@ class TestHandshake:
             sched._dev.alloc_shadow[4], nt.allocatable[4]
         )
 
-    def test_membership_change_forces_full_upload(self, sched_stack):
+    def test_node_add_rides_membership_scatter(self, sched_stack):
+        """Tentpole (PR 6): a node joining claims a headroom slot in
+        place -- the carry stays warm, the new row rides the alloc+valid
+        scatter, and NOTHING [N, R]-sized re-uploads."""
         sched = sched_stack
         cache, snap = _cluster(5)
         nt = sched.tensor_cache.update(snap)
@@ -248,7 +252,90 @@ class TestHandshake:
         )
         cache.update_snapshot(snap)
         nt = sched.tensor_cache.update(snap)
+        assert not nt.delta.full
+        new_row = nt.row("hs-new")
+        assert nt.delta.membership_rows.tolist() == [new_row]
+        neg = _negotiate(sched, nt)
+        assert neg["static_ok"] and neg["carry_ok"]
+        assert neg["sidx"].tolist() == [new_row]
+        assert neg["member"] == 1
+        assert sched.state_uploads == 1  # still only the cold upload
+        assert sched.state_reuses == 1
+        assert sched.membership_row_patches == 1
+        assert sched.carry_divergences == 0
+        # the shadow adopted the new slot's host truth
+        assert np.array_equal(
+            sched._dev.req_shadow[new_row], nt.requested[new_row]
+        )
+
+    def test_node_remove_rides_membership_scatter(self, sched_stack):
+        """A node retiring frees its slot in place: its row rides the
+        scatter (alloc zeroed, valid dropped, requested reset) with the
+        carry warm -- an expected reset, never a divergence."""
+        sched = sched_stack
+        cache, snap = _cluster(5)
+        pod = make_pod("on3").node("hs-3").container(cpu="1").obj()
+        cache.add_pod(pod)
+        cache.update_snapshot(snap)
+        nt = sched.tensor_cache.update(snap)
+        row3 = nt.row("hs-3")
+        _prime(sched, nt)
+        from kubernetes_tpu.api.types import Node, ObjectMeta
+
+        cache.remove_pod(pod)
+        cache.remove_node(Node(metadata=ObjectMeta(name="hs-3")))
+        cache.update_snapshot(snap)
+        nt = sched.tensor_cache.update(snap)
+        assert not nt.delta.full
+        assert nt.delta.membership_rows.tolist() == [row3]
+        assert nt.names[row3] == ""
+        assert not nt.valid[row3]
+        neg = _negotiate(sched, nt)
+        assert neg["static_ok"] and neg["carry_ok"]
+        assert neg["sidx"].tolist() == [row3]
+        # the slot carried requested content on device: the didx scatter
+        # must reset it (free slots are infeasible like padding)
+        assert neg["didx"].tolist() == [row3]
+        assert sched.state_uploads == 1
+        assert sched.carry_divergences == 0
+        assert sched.membership_row_patches == 1
+        assert (sched._dev.req_shadow[row3] == 0).all()
+
+    def test_membership_with_inflight_batches_drains(self, sched_stack):
+        """Membership churn while batches are in flight cannot be
+        adopted under them: the dispatcher must drain first."""
+        sched = sched_stack
+        cache, snap = _cluster(5)
+        nt = sched.tensor_cache.update(snap)
+        _prime(sched, nt)
+        cache.add_node(
+            make_node("hs-new").capacity(cpu="8", memory="16Gi").obj()
+        )
+        cache.update_snapshot(snap)
+        nt = sched.tensor_cache.update(snap)
+        assert _negotiate(sched, nt, pending_exists=True) is None
+
+    def test_headroom_exhaustion_full_repacks_once(self, sched_stack):
+        """Adds past the pre-allocated slot headroom force ONE counted
+        full repack (fresh headroom), after which churn scatters
+        again."""
+        sched = sched_stack
+        cache, snap = _cluster(5)
+        nt = sched.tensor_cache.update(snap)
+        cap = nt.capacity
+        _prime(sched, nt)
+        tc = sched.tensor_cache
+        for i in range(cap - 5 + 1):  # one past the allocated capacity
+            cache.add_node(
+                make_node(f"hs-x{i}")
+                .capacity(cpu="8", memory="16Gi")
+                .obj()
+            )
+        cache.update_snapshot(snap)
+        nt = sched.tensor_cache.update(snap)
         assert nt.delta.full
+        assert tc.full_repacks == 2
+        assert nt.capacity > cap
         neg = _negotiate(sched, nt)
         assert not neg["static_ok"] and not neg["carry_ok"]
         assert sched.state_uploads == 2
@@ -273,11 +360,11 @@ class TestHandshake:
         assert sched.carry_divergences == 1
 
 
-class TestTensorDeltaReorder:
-    def test_pure_reorder_remaps_without_repack(self):
-        """Satellite: a pure node-ordering change must NOT repack all
-        rows -- the cache permutes them and bumps only the layout
-        epoch."""
+class TestTensorDeltaMembership:
+    def test_pure_reorder_is_a_noop(self):
+        """A pure node-ordering change moves NOTHING: slots stay in
+        place, zero rows repack, the layout epoch stands (device buffers
+        remain valid row-for-row)."""
         cache, snap = _cluster(6)
         tc = NodeTensorCache()
         nt1 = tc.update(snap)
@@ -296,12 +383,18 @@ class TestTensorDeltaReorder:
         assert tc.full_repacks == 1  # NOT a membership change
         assert tc.reorders == 1
         assert tc.rows_repacked == repacked  # zero rows repacked
-        assert nt2.names == rotated
-        assert nt2.delta.layout_epoch > nt1.delta.layout_epoch
+        assert nt2.names == nt1.names  # slots do not move
+        assert nt2.delta.layout_epoch == nt1.delta.layout_epoch
+        assert nt2.delta.changed_rows.size == 0
         for name in rotated:
             assert np.array_equal(
                 nt2.allocatable[nt2.row(name)], content[name]
             ), name
+        # the packers' position->row map follows the new snapshot order
+        infos = snap.list_node_infos()
+        rows = nt2.rows_for(infos)
+        for j, ni in enumerate(infos):
+            assert int(rows[j]) == nt2.row(ni.node_name)
 
     def test_reorder_plus_changed_row_repacks_only_that_row(self):
         cache, snap = _cluster(6)
@@ -322,15 +415,37 @@ class TestTensorDeltaReorder:
         assert tc.rows_repacked == repacked + 1
         assert nt.requested[nt.row("hs-5"), 0] == 2000
 
-    def test_true_add_remove_still_full_repacks(self):
+    def test_add_claims_slot_remove_frees_it(self):
+        """Incremental membership: an add claims a headroom slot, a
+        remove retires it onto the free list, and the NEXT add reclaims
+        the lowest free slot -- zero full repacks, zero layout bumps."""
         cache, snap = _cluster(3)
         tc = NodeTensorCache()
-        tc.update(snap)
+        nt0 = tc.update(snap)
+        layout0 = nt0.delta.layout_epoch
+        from kubernetes_tpu.api.types import Node, ObjectMeta
+
         cache.add_node(make_node("hs-x").capacity(cpu="1").obj())
         cache.update_snapshot(snap)
         nt = tc.update(snap)
-        assert tc.full_repacks == 2
-        assert nt.delta.full
+        assert tc.full_repacks == 1
+        assert not nt.delta.full
+        assert nt.row("hs-x") == 3  # first headroom slot
+        cache.remove_node(Node(metadata=ObjectMeta(name="hs-1")))
+        cache.update_snapshot(snap)
+        nt = tc.update(snap)
+        assert tc.full_repacks == 1
+        assert tc.rows_retired == 1
+        assert nt.names[1] == ""
+        assert not nt.valid[1]
+        assert (nt.allocatable[1] == 0).all()
+        cache.add_node(make_node("hs-y").capacity(cpu="2").obj())
+        cache.update_snapshot(snap)
+        nt = tc.update(snap)
+        assert nt.row("hs-y") == 1  # reclaimed the freed slot
+        assert nt.valid[1]
+        assert nt.delta.layout_epoch == layout0
+        assert tc.full_repacks == 1
 
 
 class TestTensorDeltaEpochs:
@@ -385,6 +500,142 @@ class TestTensorDeltaEpochs:
         assert nt.requested[nt.row("f"), 0] == 1000
         nt = tc.update(new_snapshot([pod], [node]))
         assert nt.requested[nt.row("f"), 0] == 1000
+
+
+class TestRandomizedMembershipChurn:
+    """PR-6 satellite: interleaved node add/remove/reorder + external
+    pod churn (the bind-failure shape: content changes the scheduler
+    never mirrored) must keep (a) the slot-packed tensor equal to a
+    fresh full pack of the same cluster, per name, (b) the handshake's
+    shadow equal to host truth after every negotiation, and (c) the
+    layout epoch UNCHANGED -- pure membership churn never full-repacks
+    while adds stay inside the slot headroom."""
+
+    def test_differential_vs_fresh_pack(self, sched_stack):
+        import random
+
+        rng = random.Random(20260803)
+        sched = sched_stack
+        cache = SchedulerCache()
+        from kubernetes_tpu.api.types import Node, ObjectMeta
+
+        nodes = {}
+        pods_by_node = {}
+        seq = [0]
+
+        def new_node():
+            name = f"rc-{seq[0]}"
+            seq[0] += 1
+            node = (
+                make_node(name)
+                .capacity(cpu="16", memory="32Gi", pods=64)
+                .obj()
+            )
+            nodes[name] = node
+            pods_by_node[name] = []
+            cache.add_node(node)
+
+        for _ in range(12):
+            new_node()
+        snap = Snapshot()
+        cache.update_snapshot(snap)
+        tc = sched.tensor_cache
+        nt = tc.update(snap)
+        capacity0 = nt.capacity
+        layout0 = tc.layout_epoch
+        _prime(sched, nt)
+
+        def fresh_pack():
+            from kubernetes_tpu.cache.snapshot import new_snapshot
+
+            live_pods = [
+                p for ps in pods_by_node.values() for p in ps
+            ]
+            return NodeTensorCache().update(
+                new_snapshot(live_pods, list(nodes.values()))
+            )
+
+        uploads0 = sched.state_uploads
+        for step in range(80):
+            op = rng.choice(
+                ["add", "remove", "reorder", "pod_add", "pod_del"]
+            )
+            if op == "add" and len(nodes) < capacity0 - 2:
+                new_node()
+            elif op == "remove" and len(nodes) > 3:
+                name = rng.choice(sorted(nodes))
+                for p in pods_by_node.pop(name):
+                    cache.remove_pod(p)
+                del nodes[name]
+                cache.remove_node(
+                    Node(metadata=ObjectMeta(name=name))
+                )
+            elif op == "reorder":
+                names = list(snap.node_info_map)
+                rng.shuffle(names)
+                snap.node_info_map = {
+                    n: snap.node_info_map[n] for n in names
+                }
+                snap.refresh_lists()
+            elif op == "pod_add":
+                name = rng.choice(sorted(nodes))
+                p = (
+                    make_pod(f"rp-{step}")
+                    .node(name)
+                    .container(cpu="250m", memory="256Mi")
+                    .obj()
+                )
+                pods_by_node[name].append(p)
+                cache.add_pod(p)
+            else:  # pod_del: external removal the mirror never saw
+                cands = [n for n in sorted(nodes) if pods_by_node[n]]
+                if not cands:
+                    continue
+                name = rng.choice(cands)
+                p = pods_by_node[name].pop()
+                cache.remove_pod(p)
+            cache.update_snapshot(snap)
+            nt = tc.update(snap)
+
+            # -- handshake: carry must stay warm (scatters only) --------
+            neg = _negotiate(sched, nt)
+            assert neg is not None, f"step {step}: drain demanded"
+            assert neg["carry_ok"], f"step {step}: carry dropped"
+            s = len(nt.names)
+            assert np.array_equal(
+                sched._dev.req_shadow[:s], nt.requested[:s]
+            ), f"step {step}: shadow != host"
+
+            # -- tensor content: equal to a fresh full pack per name ----
+            fresh = fresh_pack()
+            assert sorted(n for n in nt.names if n) == sorted(
+                fresh.names
+            )
+            for name in nodes:
+                i, k = nt.row(name), fresh.row(name)
+                assert np.array_equal(
+                    nt.requested[i], fresh.requested[k]
+                ), f"step {step}: {name} requested"
+                assert np.array_equal(
+                    nt.allocatable[i], fresh.allocatable[k]
+                ), f"step {step}: {name} allocatable"
+                assert np.array_equal(
+                    nt.non_zero_requested[i],
+                    fresh.non_zero_requested[k],
+                ), f"step {step}: {name} nzr"
+                assert nt.valid[i]
+            # free slots stay infeasible like padding
+            for i, name in enumerate(nt.names):
+                if not name:
+                    assert not nt.valid[i]
+                    assert (nt.allocatable[i] == 0).all()
+                    assert (nt.requested[i] == 0).all()
+
+        # the whole churn run rode scatters: zero layout bumps, zero
+        # extra full uploads
+        assert tc.layout_epoch == layout0
+        assert tc.full_repacks == 1
+        assert sched.state_uploads == uploads0
 
 
 class TestApplyAssignmentDelta:

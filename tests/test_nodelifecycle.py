@@ -84,3 +84,154 @@ def test_recovered_heartbeat_untaints():
     ctrl.monitor_once()
     node = client.get_node("n")
     assert not any(t.key == TAINT_UNREACHABLE for t in node.spec.taints)
+
+
+class TestTaintEvictionPdbGate:
+    """PR-6 satellite: taint evictions route through the SAME
+    DisruptionController.can_disrupt budget as node drains."""
+
+    def _env(self):
+        from kubernetes_tpu.controllers import DisruptionController
+
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        disruption = DisruptionController(client, informers)
+        clock = {"now": 1000.0}
+        ctrl = NodeLifecycleController(
+            client, informers, grace_period=40.0,
+            now=lambda: clock["now"], disruption=disruption,
+        )
+        return server, client, informers, ctrl, disruption, clock
+
+    def _pdb(self, client, match, min_available):
+        from kubernetes_tpu.api.types import (
+            LabelSelector, PodDisruptionBudget,
+        )
+
+        pdb = PodDisruptionBudget(
+            selector=LabelSelector(match_labels=match),
+            min_available=min_available,
+        )
+        pdb.metadata.name = "guard"
+        pdb.metadata.namespace = "default"
+        client.create_pdb(pdb)
+
+    def test_eviction_blocked_until_budget_reopens(self):
+        server, client, informers, ctrl, disruption, clock = self._env()
+        client.create_node(
+            make_node("n").capacity(cpu="8", memory="16Gi").obj()
+        )
+        self._pdb(client, {"app": "web"}, min_available=2)
+        for i in range(2):
+            client.create_pod(
+                make_pod(f"w{i}").labels(app="web").node("n")
+                .container(cpu="1").obj()
+            )
+        kubelet = HollowKubelet(client, "n", now=lambda: clock["now"])
+        kubelet.heartbeat_once()
+        informers.pods().pump()
+        informers.nodes().pump()
+        informers.pdbs().pump()
+        disruption.sync_all()  # 2 healthy - 2 minAvailable = 0 allowed
+        clock["now"] += 120.0
+        ctrl.monitor_once()
+        # tainted, but NOTHING evicted: the budget said no
+        node = client.get_node("n")
+        assert any(t.key == TAINT_UNREACHABLE for t in node.spec.taints)
+        names = {p.metadata.name for p in client.list_pods()[0]}
+        assert names == {"w0", "w1"}
+        assert ctrl.evictions == 0
+        assert ctrl.evictions_blocked == 2
+        # replacements bind on a healthy node; the reconcile loop
+        # re-opens the budget; the NEXT monitor pass evicts
+        for i in range(2):
+            client.create_pod(
+                make_pod(f"r{i}").labels(app="web").node("m")
+                .container(cpu="1").obj()
+            )
+        informers.pods().pump()
+        disruption.sync_all()  # 4 healthy - 2 = 2 allowed
+        ctrl.monitor_once()
+        names = {p.metadata.name for p in client.list_pods()[0]}
+        assert "w0" not in names and "w1" not in names
+        assert ctrl.evictions == 2
+
+
+class TestNodeDrainer:
+    """Cordon + PDB-gated eviction (kubectl drain semantics): a drain
+    and a taint eviction spend one budget."""
+
+    def _env(self):
+        from kubernetes_tpu.controllers import (
+            DisruptionController, NodeDrainer,
+        )
+
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        disruption = DisruptionController(client, informers)
+        drainer = NodeDrainer(client, disruption=disruption, poll=0.01)
+        return server, client, informers, disruption, drainer
+
+    def test_cordon_flips_unschedulable(self):
+        server, client, informers, disruption, drainer = self._env()
+        client.create_node(make_node("n").capacity(cpu="4").obj())
+        assert drainer.cordon("n")
+        assert client.get_node("n").spec.unschedulable
+        assert drainer.uncordon("n")
+        assert not client.get_node("n").spec.unschedulable
+        assert not drainer.cordon("missing")
+
+    def test_drain_empties_node_within_budget(self):
+        server, client, informers, disruption, drainer = self._env()
+        client.create_node(make_node("n").capacity(cpu="8").obj())
+        for i in range(3):
+            client.create_pod(
+                make_pod(f"p{i}").node("n").container(cpu="1").obj()
+            )
+        informers.pods().pump()
+        # no PDB: everything is disruptable
+        assert drainer.drain("n", timeout=5.0)
+        assert drainer.evictions == 3
+        assert drainer.drains == 1
+        assert client.get_node("n").spec.unschedulable
+        assert not [
+            p for p in client.list_pods()[0]
+            if p.spec.node_name == "n"
+        ]
+
+    def test_drain_blocked_by_pdb_reports_failure(self):
+        from kubernetes_tpu.api.types import (
+            LabelSelector, PodDisruptionBudget,
+        )
+
+        server, client, informers, disruption, drainer = self._env()
+        client.create_node(make_node("n").capacity(cpu="8").obj())
+        pdb = PodDisruptionBudget(
+            selector=LabelSelector(match_labels={"app": "web"}),
+            min_available=2,
+        )
+        pdb.metadata.name = "guard"
+        pdb.metadata.namespace = "default"
+        client.create_pdb(pdb)
+        for i in range(3):
+            client.create_pod(
+                make_pod(f"p{i}").labels(app="web").node("n")
+                .container(cpu="1").obj()
+            )
+        informers.pods().pump()
+        informers.pdbs().pump()
+        disruption.sync_all()  # 3 healthy - 2 = 1 allowed
+        assert not drainer.drain("n", timeout=0.5)
+        # exactly one eviction fit the budget; the stragglers stay, the
+        # node stays cordoned (what a real drain reports back)
+        assert drainer.evictions == 1
+        assert drainer.evictions_blocked >= 1
+        assert drainer.drains == 0
+        assert client.get_node("n").spec.unschedulable
+        remaining = [
+            p for p in client.list_pods()[0]
+            if p.spec.node_name == "n"
+        ]
+        assert len(remaining) == 2

@@ -234,6 +234,81 @@ class TestAssumedPodSweep:
         assert sched.cache.is_assumed_pod(assumed)
 
 
+class TestNodeRemovedFastExpiry:
+    """PR-6 satellite: deleting a node with in-flight assumed pods must
+    route them through the sweeper on its NEXT pass -- not after the
+    30s assume TTL -- and meter the requeues."""
+
+    def test_node_delete_fast_expires_and_requeues(self):
+        # TTL is huge: only the node-removal fast path can expire
+        server, client, informers, sched = _mk_sched(ttl=3600.0)
+        informers.pump()
+        pod = make_pod("stranded").container(cpu="100m").obj()
+        client.create_pod(pod)
+        assumed = pod.assumed_clone()
+        assumed.spec.node_name = "n0"
+        sched.cache.assume_pod(assumed)
+        sched.cache.finish_binding(assumed)
+        from kubernetes_tpu.api.types import Node, ObjectMeta
+
+        sched.cache.remove_node(Node(metadata=ObjectMeta(name="n0")))
+        before = metrics.node_removed_requeues.value()
+        rec = ControlPlaneReconciler(sched, client, sweep_interval=0.01)
+        expired = rec.sweep_assumed_once()
+        assert [p.metadata.name for p in expired] == ["stranded"]
+        assert metrics.node_removed_requeues.value() == before + 1
+        assert sched.cache.get_pod(assumed) is None
+        pi = sched.queue.pop(timeout=1.0)
+        assert pi is not None and pi.pod.metadata.name == "stranded"
+
+    def test_node_delete_before_finish_binding_expires_on_finish(self):
+        """The bind is still in flight when the node dies: expiry must
+        wait for finish_binding (racing the committer would corrupt its
+        bookkeeping), then fire on the next sweep, not after the TTL."""
+        server, client, informers, sched = _mk_sched(ttl=3600.0)
+        informers.pump()
+        pod = make_pod("midbind").container(cpu="100m").obj()
+        client.create_pod(pod)
+        assumed = pod.assumed_clone()
+        assumed.spec.node_name = "n1"
+        sched.cache.assume_pod(assumed)
+        from kubernetes_tpu.api.types import Node, ObjectMeta
+
+        sched.cache.remove_node(Node(metadata=ObjectMeta(name="n1")))
+        rec = ControlPlaneReconciler(sched, client, sweep_interval=0.01)
+        # not expirable yet: the committer still owns the pod
+        assert rec.sweep_assumed_once() == []
+        assert sched.cache.is_assumed_pod(assumed)
+        sched.cache.finish_binding(assumed)
+        expired = rec.sweep_assumed_once()
+        assert [p.metadata.name for p in expired] == ["midbind"]
+
+    def test_bound_to_deleted_node_readopted_not_requeued(self):
+        """The bind LANDED before the node died: apiserver truth says
+        bound, so the sweeper re-adopts (the lifecycle harness owns the
+        kill+respawn of pods on dead nodes) and the requeue metric does
+        not move."""
+        server, client, informers, sched = _mk_sched(ttl=3600.0)
+        informers.pump()
+        pod = make_pod("landed2").container(cpu="100m").obj()
+        client.create_pod(pod)
+        assumed = pod.assumed_clone()
+        assumed.spec.node_name = "n2"
+        sched.cache.assume_pod(assumed)
+        sched.cache.finish_binding(assumed)
+        server.bind_assumed_bulk([assumed])
+        from kubernetes_tpu.api.types import Node, ObjectMeta
+
+        sched.cache.remove_node(Node(metadata=ObjectMeta(name="n2")))
+        before = metrics.node_removed_requeues.value()
+        rec = ControlPlaneReconciler(sched, client, sweep_interval=0.01)
+        rec.sweep_assumed_once()
+        assert metrics.node_removed_requeues.value() == before
+        cached = sched.cache.get_pod(assumed)
+        assert cached is not None and cached.spec.node_name == "n2"
+        assert sched.queue.pop(timeout=0.1) is None
+
+
 # ---------------------------------------------------------------------------
 # drift checker
 # ---------------------------------------------------------------------------

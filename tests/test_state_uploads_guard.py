@@ -115,3 +115,128 @@ def test_steady_state_uploads_bounded_and_oracle_parity():
     )
     assert sched.state_reuses >= sched.batches_solved - 1
     assert sched.carry_divergences == 0
+
+
+def _bind_transitions_by_uid(server):
+    """unbound->bound transitions per pod INCARNATION (uid), replayed
+    from the apiserver's full watch history (the test_ha_failover
+    harness generalized to churn: uid-keyed, so kill+respawn can't
+    mask a double-bind)."""
+    w = server.watch("Pod", since_rv=0)
+    node = {}
+    transitions = {}
+    for ev in w.pending():
+        pod = ev.object
+        uid = pod.metadata.uid
+        if ev.type == "DELETED":
+            node.pop(uid, None)
+            continue
+        prev = node.get(uid, "")
+        cur = pod.spec.node_name or ""
+        if not prev and cur:
+            transitions[uid] = transitions.get(uid, 0) + 1
+        node[uid] = cur
+    w.stop()
+    return transitions
+
+
+def test_churn_burst_uploads_bounded_no_double_binds():
+    """PR-6 guard: a 1k-pod burst with 5% node churn (2 cold nodes
+    join schedulable, 2 more flap in and out cordoned -- 2 of 40 nodes
+    flapped) keeps ``state_uploads <= 1``: membership changes ride the
+    in-buffer slot scatters, never a full [N, R] re-upload, with ZERO
+    handshake divergences and ZERO double-binds against the full watch
+    history."""
+    rng = random.Random(7)
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(
+        client, informers, batch=True, max_batch=256, rng=_KeepFirstRng(),
+    )
+    num_initial = 38
+    for i in range(num_initial):
+        client.create_node(
+            make_node(f"g{i}")
+            .capacity(cpu="64", memory="256Gi", pods=120)
+            .obj()
+        )
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+
+    def _mk_pods(lo, hi):
+        out = []
+        for i in range(lo, hi):
+            out.append(
+                make_pod(f"b{i}")
+                .creation_timestamp(float(i))
+                .container(
+                    cpu=f"{rng.choice([100, 200, 250])}m",
+                    memory=f"{rng.choice([128, 256])}Mi",
+                )
+                .obj()
+            )
+        return out
+
+    sched.start()
+    # wave 1: half the burst lands and the carry goes resident
+    for p in _mk_pods(0, 500):
+        client.create_pod(p)
+    _wait_all_bound(client, 500)
+
+    # -- the churn: cold scale-up + a cordoned flap ---------------------
+    for name in ("cold-0", "cold-1"):
+        client.create_node(
+            make_node(name)
+            .capacity(cpu="64", memory="256Gi", pods=120)
+            .obj()
+        )
+    for name in ("flap-0", "flap-1"):
+        client.create_node(
+            make_node(name)
+            .capacity(cpu="64", memory="256Gi", pods=120)
+            .unschedulable()
+            .obj()
+        )
+
+    # wave 2 schedules INTO the churn
+    for p in _mk_pods(500, 750):
+        client.create_pod(p)
+    _wait_all_bound(client, 750)
+    # the flapped nodes retire (spot reclaim of empty capacity)
+    client.delete_node("flap-0")
+    client.delete_node("flap-1")
+    for p in _mk_pods(750, 1000):
+        client.create_pod(p)
+    _wait_all_bound(client, NUM_PODS)
+    sched.wait_for_inflight_binds()
+
+    pods, _ = client.list_pods()
+    assert all(p.spec.node_name for p in pods)
+    # cold capacity actually took load: the scale-up is real
+    assert any(
+        p.spec.node_name in ("cold-0", "cold-1") for p in pods
+    ), "no pod landed on the cold scale-up nodes"
+
+    # THE guard: membership churn rode the slot scatters
+    assert sched.state_uploads <= 1, (
+        f"{sched.state_uploads} full uploads under churn -- membership "
+        f"changes are re-uploading [N, R]"
+    )
+    assert sched.carry_divergences == 0
+    assert sched.membership_row_patches >= 4  # 4 adds + 2 retires seen
+    tc = sched.tensor_cache
+    assert tc.full_repacks == 1  # only the cold pack
+    assert tc.rows_added == 4
+    assert tc.rows_retired == 2
+    assert sched.pods_fallback == 0
+    assert sched.batches_solved >= 3
+
+    # zero double-binds, against the full watch history
+    transitions = _bind_transitions_by_uid(server)
+    doubles = {u: c for u, c in transitions.items() if c > 1}
+    assert not doubles, f"double-bound incarnations: {doubles}"
+
+    sched.stop()
+    informers.stop()

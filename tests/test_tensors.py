@@ -69,12 +69,17 @@ def test_incremental_update_only_changed_rows():
     assert tc.rows_repacked == repacked_before + 1  # only n2 repacked
     assert nt.requested[nt.row("n2"), CPU] == 1000
 
-    # node add => full repack
+    # node add => claims a headroom slot in place, NO full repack (the
+    # slot layout absorbs membership churn; see test_device_state.py)
     cache.add_node(make_node("n9").capacity(cpu="2", memory="2Gi").obj())
     cache.update_snapshot(snap)
     nt = tc.update(snap)
-    assert tc.full_repacks == 2
+    assert tc.full_repacks == 1
+    assert tc.rows_added == 1
     assert "n9" in nt.names
+    assert nt.allocatable[nt.row("n9"), CPU] == 2000
+    assert nt.valid[nt.row("n9")]
+    assert nt.delta.membership_rows.tolist() == [nt.row("n9")]
 
 
 def test_topology_encoding():

@@ -22,7 +22,15 @@ Prints ONE JSON line:
                          + changed-row content check) at the same churn,
    "reuse_check_full_sweep_ms":
                          the RETIRED pre-PR-5 validation (full [N, R]
-                         np.array_equal sweep), for scale}
+                         np.array_equal sweep), for scale,
+   "member_add_ms" / "member_remove_ms" / "member_readd_ms":
+                         NodeTensorCache.update() for K node adds /
+                         removes / free-slot re-adds at M-node scale --
+                         the PR-6 slot path, O(changed rows),
+   "member_churn_rows":  K (5% of M, the rows each step touched),
+   "member_full_repack_ms":
+                         the RETIRED pre-PR-6 membership path (full
+                         M-row repack), for scale}
 
 Usage: python tools/bench_hotpath.py [--pods 10000] [--nodes 5000]
 """
@@ -204,6 +212,81 @@ def bench_node_state(num_nodes):
     return out
 
 
+def bench_membership_churn(num_nodes, churn_fraction=0.05):
+    """The PR-6 membership path: node add / remove / free-slot re-add
+    as in-place slot scatters (O(changed rows)) vs the retired full
+    repack (O(N rows)). Asserts what the churn guard test pins: zero
+    layout bumps and zero full repacks for pure membership change."""
+    from kubernetes_tpu.cache.cache import SchedulerCache
+    from kubernetes_tpu.cache.snapshot import Snapshot
+    from kubernetes_tpu.tensors import NodeTensorCache
+    from kubernetes_tpu.api.types import Node, ObjectMeta
+    from kubernetes_tpu.testing import make_node
+
+    k = max(1, int(num_nodes * churn_fraction))
+    cache = SchedulerCache()
+    for i in range(num_nodes):
+        cache.add_node(
+            make_node(f"mc-{i}")
+            .capacity(cpu="16", memory="32Gi", pods=110)
+            .obj()
+        )
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    tc = NodeTensorCache()
+    nt = tc.update(snap)  # cold full pack
+    layout0 = tc.layout_epoch
+    out = {"member_churn_rows": k}
+
+    # K cold nodes join (autoscale scale-up): claim headroom slots
+    for i in range(k):
+        cache.add_node(
+            make_node(f"mc-new-{i}")
+            .capacity(cpu="16", memory="32Gi", pods=110)
+            .obj()
+        )
+    cache.update_snapshot(snap)
+    t0 = time.perf_counter()
+    nt = tc.update(snap)
+    out["member_add_ms"] = (time.perf_counter() - t0) * 1000
+    assert nt.delta.membership_rows.size == k
+    assert not nt.delta.full
+
+    # the same K nodes reclaimed (spot storm): retire onto the free list
+    for i in range(k):
+        cache.remove_node(Node(metadata=ObjectMeta(name=f"mc-new-{i}")))
+    cache.update_snapshot(snap)
+    t0 = time.perf_counter()
+    nt = tc.update(snap)
+    out["member_remove_ms"] = (time.perf_counter() - t0) * 1000
+    assert nt.delta.membership_rows.size == k
+
+    # K replacements join (the flap closes): reclaim the freed slots
+    for i in range(k):
+        cache.add_node(
+            make_node(f"mc-re-{i}")
+            .capacity(cpu="16", memory="32Gi", pods=110)
+            .obj()
+        )
+    cache.update_snapshot(snap)
+    t0 = time.perf_counter()
+    nt = tc.update(snap)
+    out["member_readd_ms"] = (time.perf_counter() - t0) * 1000
+    assert nt.delta.membership_rows.size == k
+
+    # the acceptance shape: pure membership churn NEVER full-repacked
+    assert tc.layout_epoch == layout0, "membership churn bumped layout"
+    assert tc.full_repacks == 1, "membership churn full-repacked"
+    assert tc.rows_added == 2 * k and tc.rows_retired == k
+
+    # the retired path, for scale: what every membership change cost
+    # before PR 6 (a from-scratch repack of every row)
+    t0 = time.perf_counter()
+    NodeTensorCache().update(snap)
+    out["member_full_repack_ms"] = (time.perf_counter() - t0) * 1000
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--pods", type=int, default=10000)
@@ -229,6 +312,7 @@ def main() -> None:
     pack_ms = bench_pack(pods)
     gather_ms, assume_ms = bench_commit(pods, node_names)
     node_state = bench_node_state(args.nodes)
+    member = bench_membership_churn(args.nodes)
 
     record = {
         "metric": "hotpath_microbench",
@@ -241,6 +325,12 @@ def main() -> None:
         "commit_assume_ms": round(assume_ms, 2),
     }
     record.update({k: round(v, 3) for k, v in node_state.items()})
+    record.update(
+        {
+            k: (v if isinstance(v, int) else round(v, 3))
+            for k, v in member.items()
+        }
+    )
     print(json.dumps(record))
 
 
