@@ -30,6 +30,20 @@ stage.
 Env knobs: BENCH_NODES (default 5000), BENCH_PODS (default 10000),
 BENCH_BATCH (default 4096 -- the sweep winner: 2048 leaves round-trip
 overlap on the table, 8192 starves the commit pipeline).
+
+``--mode open-loop`` replaces the closed-loop burst with an arrival
+PROCESS (kubernetes_tpu/streaming/): a seeded trace (Poisson by
+default) feeds pods continuously through an ascending offered-rate
+ladder, and the headline is **sustained pods/s at a fixed p99
+pod-to-bind budget** -- the highest rung where every pod bound, p99
+stayed under ``--slo-p99-ms``, and the arrival engine never hit its
+backpressure stall (see README "Open-loop mode"). Three policies run
+on the SAME trace: the SLO-adaptive controller and the two static
+extremes it replaces (batch_window=0.01, and always-max_batch). A rung
+only counts if every rung below it also passed -- a config that blows
+the budget at low rate doesn't get credit for a lucky high-rate pass.
+Open-loop env knobs: OPEN_LOOP_RATES, OPEN_LOOP_STEP_S; BENCH_NODES
+defaults to 2000 in this mode.
 """
 
 from __future__ import annotations
@@ -246,6 +260,264 @@ def run_ha_chaos_bench(fault_seed: int) -> None:
     print(json.dumps(record))
 
 
+OPEN_LOOP_POLICIES = ("adaptive", "latency-static", "throughput-static")
+
+
+def _open_loop_stack(num_nodes, max_batch, policy, slo_s):
+    """One fresh scheduler stack configured for an open-loop policy:
+    the adaptive controller, or one of the two static extremes it
+    replaces (the comparison must hold everything else fixed)."""
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.client.client import Client
+    from kubernetes_tpu.client.informer import InformerFactory
+    from kubernetes_tpu.scheduler.scheduler import new_scheduler
+    from kubernetes_tpu.streaming.autobatch import AutoBatchController
+    from kubernetes_tpu.testing import make_node
+
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(client, informers, batch=True, max_batch=max_batch)
+    controller = None
+    if policy == "adaptive":
+        controller = AutoBatchController(
+            slo_p99_seconds=slo_s,
+            latency_batch=min(512, max_batch),
+            max_batch=max_batch,
+        )
+        sched.attach_autobatch(controller)
+    elif policy == "latency-static":
+        # the static default this repo shipped with: a 10ms window and
+        # every batch padded to max_batch
+        sched.batch_window = 0.01
+    elif policy == "throughput-static":
+        # always-max_batch: wait (well past the SLO if needed) for a
+        # full batch -- the pure throughput pole
+        sched.batch_window = 1.5 * slo_s
+    else:
+        raise ValueError(f"unknown open-loop policy {policy!r}")
+
+    for i in range(num_nodes):
+        client.create_node(
+            make_node(f"node-{i}")
+            .capacity(cpu="32", memory="64Gi", pods=110)
+            .obj()
+        )
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    return server, client, informers, sched, controller
+
+
+def _open_loop_step(
+    server, client, sched, *, policy, step, rate, offsets, slo_s,
+    high_prio_fraction, high_prio_value,
+):
+    """Replay one rate rung of the trace through the arrival engine and
+    measure end-to-end pod-to-bind latency. Returns the step record;
+    ``slo_met`` requires full completion, p99 <= budget, and ZERO
+    backpressure stalls (a stalled engine means the offered rate did
+    not actually enter the system)."""
+    from kubernetes_tpu.streaming.arrivals import ArrivalEngine
+    from kubernetes_tpu.testing import make_pod
+
+    n = len(offsets)
+    prefix = f"ol-{policy[:3]}-{step}"
+    high_every = (
+        int(1.0 / high_prio_fraction) if high_prio_fraction > 0 else 0
+    )
+
+    def factory(i):
+        w = make_pod(f"{prefix}-{i}").container(cpu="100m", memory="128Mi")
+        if high_every and i % high_every == 0:
+            w.priority(high_prio_value)
+        return w.obj()
+
+    names = [f"{prefix}-{i}" for i in range(n)]
+    watcher = BindWatcher(server, names)
+    # backpressure bound: generous (transient backlog is legitimate);
+    # hitting it means the rung is hopelessly over capacity
+    depth_bound = max(4 * sched.max_batch, int(2 * rate * slo_s))
+    engine = ArrivalEngine(
+        client, offsets, factory,
+        depth_fn=sched.queue.active_count,
+        max_queue_depth=depth_bound,
+    )
+    t0 = time.perf_counter()
+    engine.start()
+    deadline = time.time() + offsets[-1] + max(30.0, 10 * slo_s)
+    completed = watcher.wait_for_targets(deadline)
+    engine.stop()
+    sched.wait_for_inflight_binds(timeout=60)
+    watcher.stop()
+
+    lat, high_lat = [], []
+    for i, name in enumerate(names):
+        b = watcher.bind_times.get(name)
+        c = engine.created_ts.get(name)
+        if b is None or c is None:
+            continue
+        d = b - c
+        lat.append(d)
+        if high_every and i % high_every == 0:
+            high_lat.append(d)
+    lat.sort()
+    high_lat.sort()
+
+    def p99(vals):
+        if not vals:
+            return float("inf")
+        return vals[min(len(vals) - 1, (len(vals) * 99) // 100)]
+
+    bound = len(lat)
+    last_bind = max(watcher.bind_times.values()) if watcher.bind_times else t0
+    elapsed = max(1e-9, last_bind - t0)
+    p99_s = p99(lat)
+    slo_met = bool(
+        completed
+        and bound == n
+        and p99_s <= slo_s
+        and engine.backpressure_stalls == 0
+    )
+    rec = {
+        "offered_rate": rate,
+        "pods": n,
+        "bound": bound,
+        "sustained_pods_per_sec": round(bound / elapsed, 1),
+        "p50_pod_to_bind_ms": round(
+            (lat[len(lat) // 2] if lat else float("inf")) * 1000, 1
+        ),
+        "p99_pod_to_bind_ms": round(p99_s * 1000, 1),
+        "backpressure_stalls": engine.backpressure_stalls,
+        "slo_met": slo_met,
+    }
+    if high_lat:
+        rec["high_band_p99_ms"] = round(p99(high_lat) * 1000, 1)
+        rec["high_band_pods"] = len(high_lat)
+    return rec
+
+
+def run_open_loop_bench(args) -> None:
+    """The open-loop harness: for each policy, walk the offered-rate
+    ladder on the SAME seeded trace shapes and report sustained pods/s
+    at the p99 budget. The ladder is monotone: the first failing rung
+    stops the walk, so the headline rate is one every lower rung also
+    met (a latency policy can't lose at 1k and "win" at 8k)."""
+    from kubernetes_tpu.streaming.arrivals import load_trace
+
+    num_nodes = int(os.environ.get("BENCH_NODES", 2000))
+    max_batch = int(os.environ.get("BENCH_BATCH", 4096))
+    rates = [
+        float(r) for r in (
+            args.rates or os.environ.get(
+                "OPEN_LOOP_RATES", "500,1000,2000,4000"
+            )
+        ).split(",")
+    ]
+    step_s = float(os.environ.get("OPEN_LOOP_STEP_S", 8.0))
+    slo_s = args.slo_p99_ms / 1000.0
+    policies = [
+        p.strip() for p in args.policies.split(",") if p.strip()
+    ]
+
+    from kubernetes_tpu.testing import make_pod
+
+    per_policy = {}
+    for policy in policies:
+        server, client, informers, sched, controller = _open_loop_stack(
+            num_nodes, max_batch, policy, slo_s
+        )
+        if args.high_prio_fraction > 0:
+            # arm band-aware draining for the high-priority arrivals
+            # (priority 100 >= 50): their p99 rides each step record
+            sched.queue.band_threshold = 50
+        # compile + warm the full pipeline off the clock (same protocol
+        # as the closed-loop bench)
+        sched.warmup()
+        warm = [
+            make_pod(f"warm-{policy[:3]}-{i}")
+            .container(cpu="100m", memory="128Mi").obj()
+            for i in range(max_batch)
+        ]
+        warm_watch = BindWatcher(server, [p.metadata.name for p in warm])
+        for p in warm:
+            client.create_pod(p)
+        sched.start()
+        if not warm_watch.wait_for_targets(time.time() + 600):
+            # a broken policy stack must not abort the comparison:
+            # score it as failed, tear it down, run the others
+            warm_watch.stop()
+            sched.stop()
+            informers.stop()
+            per_policy[policy] = {
+                "sustained_at_slo_pods_per_sec": 0.0,
+                "rate_at_slo": 0.0,
+                "steps": [],
+                "error": "warmup incomplete",
+            }
+            continue
+        warm_watch.stop()
+        sched.wait_for_inflight_binds(timeout=60)
+
+        steps = []
+        best = None
+        for idx, rate in enumerate(rates):
+            # same (kind, rate, seed) per rung across policies: the
+            # policies see IDENTICAL arrival instants
+            offsets = load_trace(
+                args.trace, rate, step_s, seed=args.trace_seed + idx,
+                replay_path=args.trace_replay,
+            )
+            if offsets.size == 0:
+                continue
+            rec = _open_loop_step(
+                server, client, sched,
+                policy=policy, step=idx, rate=rate, offsets=offsets,
+                slo_s=slo_s,
+                high_prio_fraction=args.high_prio_fraction,
+                high_prio_value=100,
+            )
+            if controller is not None:
+                rec["controller"] = {
+                    "window_ms": round(controller.window * 1000, 2),
+                    "batch_cap": controller.batch_cap,
+                    "window_changes": controller.window_changes,
+                    "cap_changes": controller.cap_changes,
+                }
+            steps.append(rec)
+            print(json.dumps({"policy": policy, **rec}), file=sys.stderr)
+            if not rec["slo_met"]:
+                break
+            best = rec
+        sched.stop()
+        informers.stop()
+        per_policy[policy] = {
+            "sustained_at_slo_pods_per_sec": (
+                best["sustained_pods_per_sec"] if best else 0.0
+            ),
+            "rate_at_slo": best["offered_rate"] if best else 0.0,
+            "steps": steps,
+        }
+
+    headline_policy = "adaptive" if "adaptive" in per_policy else policies[0]
+    headline = per_policy[headline_policy]
+    record = {
+        "metric": "open_loop_sustained_at_slo",
+        "value": headline["sustained_at_slo_pods_per_sec"],
+        "unit": "pods/s",
+        "policy": headline_policy,
+        "slo_p99_ms": args.slo_p99_ms,
+        "trace": args.trace,
+        "trace_seed": args.trace_seed,
+        "step_seconds": step_s,
+        "rates": rates,
+        "nodes": num_nodes,
+        "max_batch": max_batch,
+        "policies": per_policy,
+    }
+    print(json.dumps(record))
+
+
 def pick_median_trial(trials):
     """The headline trial: median by throughput (even counts round to
     the LOWER middle, i.e. the more conservative of the two)."""
@@ -353,6 +625,50 @@ def main() -> None:
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
+        "--mode", default=os.environ.get("BENCH_MODE", "burst"),
+        choices=("burst", "open-loop"),
+        help="burst = the closed-loop drain bench; open-loop = an "
+        "arrival PROCESS replayed through an offered-rate ladder, "
+        "reporting sustained pods/s at a fixed p99 pod-to-bind budget",
+    )
+    ap.add_argument(
+        "--trace", default=os.environ.get("OPEN_LOOP_TRACE", "poisson"),
+        choices=("poisson", "bursty", "diurnal", "replay"),
+        help="open-loop arrival trace kind (streaming/arrivals.py)",
+    )
+    ap.add_argument(
+        "--trace-seed", type=int,
+        default=int(os.environ.get("OPEN_LOOP_SEED", 0)),
+        help="seed for the arrival trace (recorded in the result; the "
+        "same seed reproduces identical arrival instants)",
+    )
+    ap.add_argument(
+        "--trace-replay", default="",
+        help="JSON trace file for --trace replay",
+    )
+    ap.add_argument(
+        "--rates", default="",
+        help="comma-separated offered-rate ladder in pods/s "
+        "(default env OPEN_LOOP_RATES or 500,1000,2000,4000)",
+    )
+    ap.add_argument(
+        "--slo-p99-ms", type=float,
+        default=float(os.environ.get("OPEN_LOOP_SLO_MS", 1000.0)),
+        help="the p99 pod-to-bind budget the open-loop headline is "
+        "anchored to",
+    )
+    ap.add_argument(
+        "--policies", default=",".join(OPEN_LOOP_POLICIES),
+        help="open-loop policies to compare on the same trace "
+        "(adaptive,latency-static,throughput-static)",
+    )
+    ap.add_argument(
+        "--high-prio-fraction", type=float,
+        default=float(os.environ.get("OPEN_LOOP_HIGH_PRIO", 0.0)),
+        help="fraction of open-loop arrivals stamped priority=100; "
+        "their band p99 is reported separately",
+    )
+    ap.add_argument(
         "--fault-profile", default=os.environ.get("BENCH_FAULT_PROFILE", ""),
         help="named fault-injection profile (robustness/faults.py: "
         "chaos-default, device-down, garbage-scores, flaky-watch, "
@@ -384,6 +700,10 @@ def main() -> None:
     if args.fault_profile == "ha-chaos":
         # the HA failover bench has its own two-stack harness
         run_ha_chaos_bench(args.fault_seed)
+        return
+
+    if args.mode == "open-loop":
+        run_open_loop_bench(args)
         return
 
     num_nodes = int(os.environ.get("BENCH_NODES", 5000))

@@ -381,6 +381,36 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         solver_mode=wl.get("solver_mode", "greedy"),
     )
 
+    # workload-scoped open-loop streaming (kubernetes_tpu/streaming/):
+    # the measured pods arrive as a seeded trace through the
+    # ArrivalEngine instead of one t=0 bulk create, the SLO-adaptive
+    # controller replaces the static batch window, and the backpressure
+    # bound gates the engine. Attached BEFORE warmup so the controller's
+    # latency solve pad is compiled off the clock.
+    streaming = None
+    controller = None
+    if wl.get("streaming"):
+        from kubernetes_tpu.config.loader import streaming_from_dict
+        from kubernetes_tpu.streaming.autobatch import AutoBatchController
+
+        # same camelCase schema as the top-level config's streaming:
+        # block; in a workload block the controller defaults ON
+        streaming = streaming_from_dict(
+            {"enabled": True, **wl["streaming"]}
+        )
+        if streaming.enabled:
+            controller = AutoBatchController(
+                slo_p99_seconds=streaming.slo_p99_seconds,
+                min_window=streaming.min_window_seconds,
+                max_window=streaming.max_window_seconds,
+                latency_batch=streaming.latency_batch,
+                max_batch=max_batch,
+                interval_seconds=streaming.controller_interval_seconds,
+            )
+            sched.attach_autobatch(controller)
+        if streaming.band_priority_threshold is not None:
+            sched.queue.band_threshold = streaming.band_priority_threshold
+
     for i in range(num_nodes):
         nw = make_node(f"node-{i}").capacity(
             cpu=str(node_spec.get("cpu", defaults.get("node_cpu", "32"))),
@@ -541,6 +571,7 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         hollow.start()
 
     coll = None
+    engine = None
     try:
         informers.start()
         informers.wait_for_cache_sync()
@@ -633,7 +664,55 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
             )
             scenario_thread.start()
         ok = True
-        if churn:
+        streaming_rec: Dict[str, Any] = {}
+        if streaming:
+            from kubernetes_tpu.streaming.arrivals import (
+                ArrivalEngine, trace_from_config,
+            )
+
+            # generate until the trace covers every measured pod, then
+            # trim: the workload measures exactly measure_pods arrivals.
+            # A replay trace is FIXED -- growing the duration cannot add
+            # arrivals, so an undersized recording is a config error,
+            # not a retry loop
+            dur = measure_pods / streaming.rate_pods_per_sec
+            offsets = trace_from_config(streaming, duration=dur)
+            if streaming.trace == "replay":
+                if offsets.size < measure_pods:
+                    return {
+                        "name": name,
+                        "error": (
+                            f"replay trace holds {offsets.size} arrivals "
+                            f"< measure_pods {measure_pods}"
+                        ),
+                    }
+            else:
+                while offsets.size < measure_pods:
+                    dur *= 1.3
+                    offsets = trace_from_config(streaming, duration=dur)
+            offsets = offsets[:measure_pods]
+            engine = ArrivalEngine(
+                client, offsets, lambda i: pods[i],
+                depth_fn=sched.queue.active_count,
+                max_queue_depth=streaming.max_queue_depth,
+            )
+            engine.start()
+            frac = float(wl.get("min_bound_fraction", 1.0))
+            if frac < 1.0:
+                ok = coll.wait_fraction(frac, timeout_s)
+            else:
+                ok = coll.wait(timeout_s)
+            engine.stop()
+            create_times.update(engine.created_ts)
+            streaming_rec = {
+                "trace": streaming.trace,
+                "rate": streaming.rate_pods_per_sec,
+                "seed": streaming.seed,
+                "arrived": engine.created,
+                "backpressure_stalls": engine.backpressure_stalls,
+                "stall_seconds": round(engine.stall_seconds, 3),
+            }
+        elif churn:
             # BASELINE #5: steady-state churn -- delete a slice of running
             # pods and schedule replacements, round after round
             rounds = int(churn.get("rounds", 5))
@@ -821,6 +900,15 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
             result["solver"]["tensor_rows_retired"] = tc.rows_retired
         if lifecycle_counters:
             result["lifecycle"] = lifecycle_counters
+        if streaming_rec:
+            if controller is not None:
+                streaming_rec.update(
+                    window_ms=round(controller.window * 1000, 2),
+                    batch_cap=controller.batch_cap,
+                    window_changes=controller.window_changes,
+                    cap_changes=controller.cap_changes,
+                )
+            result["streaming"] = streaming_rec
         return result
     finally:
         # EVERY component stops on EVERY exit path (including exceptions
@@ -829,6 +917,8 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         # perturb every later workload in the matrix
         if coll is not None:
             coll.stop()
+        if engine is not None:
+            engine.stop()
         if lifecycle_stop is not None:
             lifecycle_stop.set()
         for comp in lifecycle_stoppers:
@@ -858,6 +948,12 @@ def to_data_items(results: List[Dict[str, Any]]) -> Dict[str, Any]:
             {
                 f"lifecycle_{k}": str(v)
                 for k, v in (r.get("lifecycle") or {}).items()
+            }
+        )
+        labels.update(
+            {
+                f"streaming_{k}": str(v)
+                for k, v in (r.get("streaming") or {}).items()
             }
         )
         if r.get("error") or not r.get("ok", False):

@@ -24,6 +24,7 @@ from kubernetes_tpu.config.types import (
     Plugins,
     ResilienceConfiguration,
     RobustnessConfiguration,
+    StreamingConfiguration,
     TPUSolverConfiguration,
 )
 from kubernetes_tpu.scheduler.extender import ExtenderConfig
@@ -117,6 +118,40 @@ def _extender(raw: Dict[str, Any]) -> ExtenderConfig:
     )
 
 
+def streaming_from_dict(st_raw: Dict[str, Any]) -> StreamingConfiguration:
+    """Parse a ``streaming:`` block (camelCase wire form). Shared by
+    the top-level config loader and the perf-matrix runner's
+    workload-scoped blocks, so both speak the same schema."""
+    return StreamingConfiguration(
+        enabled=bool(st_raw.get("enabled", False)),
+        slo_p99_seconds=_duration_seconds(st_raw.get("sloP99", 1.0)),
+        min_window_seconds=_duration_seconds(st_raw.get("minWindow", 0.0)),
+        max_window_seconds=_duration_seconds(st_raw.get("maxWindow", 0.25)),
+        latency_batch=int(st_raw.get("latencyBatch", 512)),
+        controller_interval_seconds=_duration_seconds(
+            st_raw.get("controllerInterval", 0.25)
+        ),
+        band_priority_threshold=(
+            int(st_raw["bandPriorityThreshold"])
+            if "bandPriorityThreshold" in st_raw
+            else None
+        ),
+        max_queue_depth=int(st_raw.get("maxQueueDepth", 20000)),
+        trace=st_raw.get("trace", "poisson"),
+        rate_pods_per_sec=float(st_raw.get("rate", 1000.0)),
+        duration_seconds=_duration_seconds(st_raw.get("duration", 30.0)),
+        seed=int(st_raw.get("seed", 0)),
+        burst_rate_pods_per_sec=float(st_raw.get("burstRate", 0.0)),
+        base_dwell_seconds=_duration_seconds(st_raw.get("baseDwell", 8.0)),
+        burst_dwell_seconds=_duration_seconds(
+            st_raw.get("burstDwell", 2.0)
+        ),
+        period_seconds=_duration_seconds(st_raw.get("period", 60.0)),
+        trough_fraction=float(st_raw.get("troughFraction", 0.2)),
+        replay_path=st_raw.get("replayPath", ""),
+    )
+
+
 def load_config_from_dict(raw: Dict[str, Any]) -> KubeSchedulerConfiguration:
     le_raw = raw.get("leaderElection", {})
     cfg = KubeSchedulerConfiguration(
@@ -183,6 +218,7 @@ def load_config_from_dict(raw: Dict[str, Any]) -> KubeSchedulerConfiguration:
         ),
         commit_fencing=bool(rs_raw.get("commitFencing", True)),
     )
+    cfg.streaming = streaming_from_dict(raw.get("streaming", {}))
     fi_raw = raw.get("faultInjection", {})
     cfg.fault_injection = FaultInjectionConfiguration(
         enabled=bool(fi_raw.get("enabled", False)),

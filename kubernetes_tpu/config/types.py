@@ -137,6 +137,43 @@ class TPUSolverConfiguration:
 
 
 @dataclass
+class StreamingConfiguration:
+    """Open-loop streaming knobs (kubernetes_tpu/streaming/): the
+    SLO-adaptive batch controller, priority-band queue jumping, and the
+    arrival-engine backpressure bound. ``enabled`` turns on the
+    controller (it replaces the static batchWindow/maxBatch behavior
+    with feedback between its latency and throughput poles); the trace
+    fields describe the arrival process the bench/runner replay."""
+
+    enabled: bool = False
+    # -- the SLO + controller -------------------------------------------
+    slo_p99_seconds: float = 1.0
+    min_window_seconds: float = 0.0
+    #: upper window bound; clamped to slo/2 by the controller
+    max_window_seconds: float = 0.25
+    #: latency-mode dispatch cap (also the latency solve pad rung)
+    latency_batch: int = 512
+    controller_interval_seconds: float = 0.25
+    # -- priority bands --------------------------------------------------
+    #: pods with spec.priority >= this form the high band; None = off
+    band_priority_threshold: Optional[int] = None
+    # -- backpressure ----------------------------------------------------
+    #: activeQ depth that stalls the arrival engine; 0 = unbounded
+    max_queue_depth: int = 20000
+    # -- arrival trace (bench/runner replay) -----------------------------
+    trace: str = "poisson"  # poisson | bursty | diurnal | replay
+    rate_pods_per_sec: float = 1000.0
+    duration_seconds: float = 30.0
+    seed: int = 0
+    burst_rate_pods_per_sec: float = 0.0  # bursty high state (0 = 4x)
+    base_dwell_seconds: float = 8.0
+    burst_dwell_seconds: float = 2.0
+    period_seconds: float = 60.0  # diurnal cycle length
+    trough_fraction: float = 0.2  # diurnal trough / peak ratio
+    replay_path: str = ""
+
+
+@dataclass
 class RobustnessConfiguration:
     """Degradation-ladder knobs (robustness/ladder.py): per-tier circuit
     breakers, device-solve watchdog, solve/bind retry policy."""
@@ -198,4 +235,7 @@ class KubeSchedulerConfiguration:
     )
     fault_injection: FaultInjectionConfiguration = field(
         default_factory=FaultInjectionConfiguration
+    )
+    streaming: StreamingConfiguration = field(
+        default_factory=StreamingConfiguration
     )

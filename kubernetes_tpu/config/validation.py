@@ -122,6 +122,32 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
         if rs.drift_check_interval_seconds <= 0:
             errors.append("resilience.driftCheckInterval must be positive")
 
+    st = getattr(cfg, "streaming", None)
+    if st is not None:
+        if st.trace not in ("poisson", "bursty", "diurnal", "replay"):
+            errors.append(
+                f"streaming.trace must be poisson|bursty|diurnal|replay, "
+                f"got {st.trace!r}"
+            )
+        if st.trace == "replay" and not st.replay_path:
+            errors.append("streaming.replayPath is required for replay")
+        if st.slo_p99_seconds <= 0:
+            errors.append("streaming.sloP99 must be positive")
+        if st.min_window_seconds < 0:
+            errors.append("streaming.minWindow must be >= 0")
+        if st.max_window_seconds < st.min_window_seconds:
+            errors.append("streaming.maxWindow must be >= minWindow")
+        if st.latency_batch <= 0:
+            errors.append("streaming.latencyBatch must be positive")
+        if st.controller_interval_seconds <= 0:
+            errors.append("streaming.controllerInterval must be positive")
+        if st.rate_pods_per_sec <= 0:
+            errors.append("streaming.rate must be positive")
+        if st.max_queue_depth < 0:
+            errors.append("streaming.maxQueueDepth must be >= 0")
+        if not 0.0 <= st.trough_fraction <= 1.0:
+            errors.append("streaming.troughFraction must be in [0, 1]")
+
     fi = getattr(cfg, "fault_injection", None)
     if fi is not None and fi.enabled:
         from kubernetes_tpu.robustness.faults import (

@@ -105,6 +105,15 @@ class PriorityQueue:
         self.scheduling_cycle = 0
         self.move_request_cycle = 0
         self._closed = False
+        self.last_pop_wait_seconds = 0.0
+        # priority-band queue jumping (streaming subsystem): pods with
+        # spec.priority >= band_threshold form the HIGH band. The heap
+        # already sorts them first; the band additionally cuts the batch
+        # window short whenever a high-band pod is in (or joins) the
+        # draining batch, so a latency-critical pod never waits out a
+        # throughput-mode window behind a bulk backlog. None = off
+        # (zero cost on the drain path).
+        self.band_threshold: Optional[int] = None
 
     # -- backoff ------------------------------------------------------------
 
@@ -273,13 +282,30 @@ class PriorityQueue:
         self,
         max_size: int,
         timeout: Optional[float] = None,
-        window: float = 0.0,
+        window=0.0,
     ) -> List[PodInfo]:
         """TPU batch drain: block for the first pod, then take up to
         ``max_size``. With ``window > 0``, wait up to that long for more
         arrivals before returning a partial batch -- amortizes the fixed
         per-solve cost (device transfer + dispatch) during a burst at the
         price of a bounded latency add for the first pods.
+
+        ``window`` may be a CALLABLE returning the current window (the
+        SLO-adaptive controller mutates it while a drain is waiting).
+        The window deadline is re-read at every wakeup but can only
+        move EARLIER: a mid-window controller shrink applies
+        immediately, while a grow never extends an already-armed
+        deadline -- the pods already in the batch were promised the
+        window in force when they were drained.
+
+        Priority bands (``band_threshold``): when the batch holds a pod
+        at or above the threshold -- drained on entry or arriving during
+        a window wait -- the window is cut short and the batch
+        dispatches now. High-band pods already sort first in the heap;
+        the cut means a bulk backlog's throughput-mode window can never
+        add latency in front of them. Band queue-wait histograms
+        (``scheduler_queue_band_wait_seconds``) are recorded per drain
+        when bands are on.
 
         The drain is BULK: one lock hold pulls every available pod
         through ``Heap.pop_bulk`` (a single native sort) instead of one
@@ -293,10 +319,15 @@ class PriorityQueue:
         ``last_pop_wait_seconds`` holds the wall clock THIS call spent
         blocked waiting for arrivals (first pod + window waits), so the
         caller's stage timers can report drain WORK separately from
-        idle wait (single dispatcher thread; stats only)."""
+        idle wait (single dispatcher thread; stats only). Window waits
+        cut short by a band arrival still count only the time actually
+        waited -- the split stays honest under band-aware drains."""
         deadline = None if timeout is None else self._now() + timeout
+        window_fn = window if callable(window) else None
+        band = self.band_threshold
         batch: List[PodInfo] = []
         waited = 0.0
+        has_high = False
         try:
             with self._cond:
                 # block for the first arrival (pop()'s wait loop, inlined
@@ -320,16 +351,37 @@ class PriorityQueue:
                             and len(self.active_q) == 0
                         ):
                             return batch
-                window_deadline = self._now() + window
+                window_start = self._now()
+                window_deadline = window_start + (
+                    window_fn() if window_fn is not None else window
+                )
                 while True:
                     drained = self.active_q.pop_bulk(max_size - len(batch))
                     if drained:
+                        now = self._now()
                         for pi in drained:
                             pi.attempts += 1
                         self.scheduling_cycle += len(drained)
                         batch.extend(drained)
+                        if band is not None:
+                            has_high = has_high or any(
+                                pi.pod.spec.priority >= band
+                                for pi in drained
+                            )
+                            self._observe_band_waits(drained, band, now)
                     if len(batch) >= max_size or self._closed:
                         break
+                    if has_high:
+                        # a high-band pod is aboard: dispatch now; the
+                        # window exists to amortize bulk work, not to
+                        # tax the latency band
+                        break
+                    if window_fn is not None:
+                        # adaptive window: shrink applies mid-wait, a
+                        # grow never extends the armed deadline
+                        window_deadline = min(
+                            window_deadline, window_start + window_fn()
+                        )
                     remaining = window_deadline - self._now()
                     if remaining <= 0:
                         break
@@ -339,6 +391,27 @@ class PriorityQueue:
             return batch
         finally:
             self.last_pop_wait_seconds = waited
+
+    @staticmethod
+    def _observe_band_waits(
+        drained: List[PodInfo], band: int, now: float
+    ) -> None:
+        """Per-band queue-wait histograms (only when bands are on):
+        enqueue-to-drain wall clock, split high vs bulk."""
+        from kubernetes_tpu.utils import metrics
+
+        high = []
+        bulk = []
+        for pi in drained:
+            wait = max(0.0, now - pi.timestamp)
+            if pi.pod.spec.priority >= band:
+                high.append(wait)
+            else:
+                bulk.append(wait)
+        if high:
+            metrics.queue_band_wait.observe_many(high, band="high")
+        if bulk:
+            metrics.queue_band_wait.observe_many(bulk, band="bulk")
 
     # -- move machinery -----------------------------------------------------
 

@@ -335,6 +335,19 @@ class BatchScheduler(Scheduler):
         self.solver_config = solver_config
         self.tensor_cache = tensor_cache or NodeTensorCache()
         self.batch_window = batch_window
+        # SLO-adaptive batching (streaming/autobatch.py): when a
+        # controller is attached it rewrites batch_window AND these two
+        # knobs between batches -- dispatch_batch_cap bounds how many
+        # pods one pop_batch drains, solve_pad floors the padded solve
+        # shape below max_batch so latency-mode batches stop paying the
+        # full-pad fixed solve cost. None = static knobs (today's
+        # behavior, zero overhead).
+        self.autobatch = None
+        self.dispatch_batch_cap: Optional[int] = None
+        self.solve_pad: Optional[int] = None
+        # solve-pad shapes warmup() pre-compiles beyond max_batch
+        # (attach_autobatch adds the controller's latency rung)
+        self._warmup_pads: set = {max_batch}
         if solver_mode not in ("greedy", "sinkhorn"):
             raise ValueError(f"unknown solver_mode {solver_mode!r}")
         self.solver_mode = solver_mode
@@ -437,9 +450,25 @@ class BatchScheduler(Scheduler):
         and committing the previous result, so the serving link's
         round-trip latency is overlapped with host commit work instead of
         serializing with it."""
+        ab = self.autobatch
+        if ab is not None:
+            # one controller decision per interval, taken between
+            # batches on the dispatcher thread (deterministic ordering
+            # with the drain; the callable window below lets a shrink
+            # land mid-wait too)
+            ab.maybe_step(self)
+        cap = self.dispatch_batch_cap
+        size = (
+            self.max_batch
+            if not cap
+            else max(1, min(self.max_batch, cap))
+        )
         t_pop = time.perf_counter()
         batch_infos = self.queue.pop_batch(
-            self.max_batch, timeout=timeout, window=self.batch_window
+            size,
+            timeout=timeout,
+            window=(self._live_window if ab is not None
+                    else self.batch_window),
         )
         dt_pop = time.perf_counter() - t_pop
         # split drain WORK from arrival wait: blocking on an empty queue
@@ -786,6 +815,31 @@ class BatchScheduler(Scheduler):
                 self._ensure_vol_columns(adm)
             out.append(adm)
         return out
+
+    def _live_window(self) -> float:
+        """Window source handed to pop_batch when the adaptive
+        controller is attached. The queue calls it at every window
+        wakeup, so the controller is re-polled MID-WINDOW (still
+        interval-gated, and re-entrant on the queue's RLock since this
+        runs on the dispatcher thread): a shrink decided while a drain
+        is waiting lands on that drain immediately, while the queue
+        clamps the deadline so a grow never extends it."""
+        ab = self.autobatch
+        if ab is not None:
+            ab.maybe_step(self)
+        return self.batch_window
+
+    def attach_autobatch(self, controller) -> None:
+        """Wire an AutoBatchController (streaming/autobatch.py) into the
+        dispatch loop: its latency-mode solve pad joins the warmup
+        compile set so rung switches never pay JIT latency mid-run, and
+        its current outputs are applied immediately."""
+        self.autobatch = controller
+        self._warmup_pads.add(int(controller.latency_batch))
+        self._warmup_pads.add(int(controller.max_batch))
+        self.batch_window = controller.window
+        self.dispatch_batch_cap = controller.batch_cap
+        self.solve_pad = controller.batch_cap
 
     def _stage_add(self, name: str, seconds: float) -> None:
         # lock-free on the hot path: each thread owns its accumulator
@@ -1431,9 +1485,18 @@ class BatchScheduler(Scheduler):
 
         b = batch.size
         # fixed solve shape: every batch pads to max_batch so the solver
-        # JITs exactly once per (node-bucket, variant)
+        # JITs exactly once per (node-bucket, variant). The adaptive
+        # controller may floor the pad at its latency rung instead --
+        # small batches then run a proportionally cheaper solve -- but
+        # only when the batch actually fits the rung (a deferred
+        # preemption wave can exceed the cap and falls back to the
+        # max_batch signature), so the signature set stays exactly
+        # {latency rung, max_batch} plus the defensive oversize bucket.
+        pad_floor = self.solve_pad
+        if not pad_floor or b > pad_floor:
+            pad_floor = self.max_batch
         padded = max(
-            self.max_batch, POD_BUCKET * math.ceil(b / POD_BUCKET)
+            pad_floor, POD_BUCKET * math.ceil(b / POD_BUCKET)
         )
         order = batch.order
         req = np.zeros((padded, nt.dims.num_dims), dtype=np.int32)
@@ -2747,15 +2810,28 @@ class BatchScheduler(Scheduler):
         """Compile every solver variant for the current cluster shape so
         no measured batch pays JIT latency (the reference harness similarly
         schedules warm-up pods before b.ResetTimer,
-        scheduler_perf_test.go:130)."""
+        scheduler_perf_test.go:130).
+
+        With the adaptive controller attached, its latency-rung solve
+        pad is compiled too (basic path only -- constrained families on
+        the latency rung are rare enough that the one-time compile can
+        land on demand), so a controller rung switch never pays JIT
+        latency inside a measured window."""
         snapshot = self.algorithm.snapshot
         self.cache.update_snapshot(snapshot)
         nt = self.tensor_cache.update(snapshot)
-        n = nt.capacity
-        if n == 0:
+        if nt.capacity == 0:
             return
+        extra = sorted(
+            int(p) for p in self._warmup_pads
+            if p and int(p) != self.max_batch
+        )
+        for padded in [self.max_batch] + extra:
+            self._warmup_at(nt, padded, full=padded == self.max_batch)
+
+    def _warmup_at(self, nt, padded: int, full: bool) -> None:
+        n = nt.capacity
         r = nt.dims.num_dims
-        padded = self.max_batch
         host = (
             nt.allocatable, nt.requested, nt.non_zero_requested, nt.valid,
             np.zeros((padded, r), dtype=np.int32),
@@ -2820,6 +2896,9 @@ class BatchScheduler(Scheduler):
                 config=self.solver_config, mode=self.solver_mode,
             )
             jax.block_until_ready(steady)
+        if not full:
+            # extra (latency-rung) pads warm the basic path only
+            return
         noops = (
             noop_spread_tensors(padded, n),
             noop_affinity_tensors(padded, n),

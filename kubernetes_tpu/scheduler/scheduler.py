@@ -744,6 +744,27 @@ def new_scheduler_from_config(
     )
     if ts.enabled:
         sched.batch_window = ts.batch_window_seconds
+    st = getattr(cfg, "streaming", None)
+    if st is not None and st.enabled:
+        # open-loop streaming: the priority-band threshold arms queue
+        # jumping on ANY scheduler (the band lives in the queue), and
+        # the SLO-adaptive controller replaces the static batchWindow/
+        # maxBatch behavior on the batch path (streaming/autobatch.py)
+        if st.band_priority_threshold is not None:
+            sched.queue.band_threshold = st.band_priority_threshold
+        if ts.enabled:
+            from kubernetes_tpu.streaming.autobatch import (
+                AutoBatchController,
+            )
+
+            sched.attach_autobatch(AutoBatchController(
+                slo_p99_seconds=st.slo_p99_seconds,
+                min_window=st.min_window_seconds,
+                max_window=st.max_window_seconds,
+                latency_batch=st.latency_batch,
+                max_batch=ts.max_batch,
+                interval_seconds=st.controller_interval_seconds,
+            ))
     injector = injector_from_configuration(cfg.fault_injection)
     if injector is not None:
         install_injector(injector)

@@ -1,0 +1,222 @@
+"""SLO-adaptive batch controller: the feedback loop that replaces the
+static ``batch_window``/``max_batch`` knobs.
+
+The dispatcher's batching knobs trade latency for throughput: a short
+window dispatches small, cheap-to-wait-for batches (every solve still
+pays the fixed padded-dispatch cost), a long window fills batches
+toward ``max_batch`` and amortizes that fixed cost. No static setting
+serves an arrival *process* at both ends -- shallow-queue periods want
+the short window, backlog wants the long one. The
+``AutoBatchController`` closes the loop from the signals the dispatch
+path already produces:
+
+- queue depth (``queue.active_count``) and the pop counter
+  (``queue.scheduling_cycle``) give a drain-rate estimate, so
+  ``depth / rate`` estimates the backlog sojourn a pod joining now
+  will see;
+- the always-on per-thread stage timers (PR 4) split ``pop_wait``
+  (dispatcher blocked on arrivals) from drain work, so a transiently
+  deep queue on an otherwise-idle dispatcher doesn't trigger a grow.
+
+Control law (deliberately simple, deterministic, and hysteretic):
+
+- **throughput mode** when the estimated sojourn exceeds
+  ``grow_fraction * slo``: double the window toward ``max_window``
+  (clamped to ``slo/2`` -- the window itself must never spend the
+  latency budget) and raise the dispatch cap to ``max_batch``.
+- **latency mode** when the estimated sojourn is under
+  ``shrink_fraction * slo`` AND the queue is shallower than one
+  latency-mode batch: halve the window toward ``min_window`` and drop
+  the dispatch cap to ``latency_batch`` (which also shrinks the padded
+  solve shape -- small batches stop paying the full-pad solve cost).
+- **hold** inside the hysteresis band -- on a steady trace the
+  controller converges and stops moving (the tier-1 oscillation guard
+  pins this).
+
+``step()`` is a pure function of its arguments plus controller state:
+a fixed input sequence always produces the same window/cap trajectory
+(deterministic-trace convergence tests). ``maybe_step()`` is the
+time-gated wrapper the dispatch loop calls once per
+``interval_seconds``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from kubernetes_tpu.utils import metrics
+
+#: batch sizes quantize to this (mirrors scheduler/batch.py POD_BUCKET
+#: without importing the scheduler -- the controller must stay
+#: dependency-light so the queue/bench layers can use it standalone)
+BATCH_BUCKET = 64
+
+
+class AutoBatchController:
+    def __init__(
+        self,
+        *,
+        slo_p99_seconds: float = 1.0,
+        min_window: float = 0.0,
+        max_window: Optional[float] = None,
+        latency_batch: int = 512,
+        max_batch: int = 4096,
+        interval_seconds: float = 0.25,
+        grow_fraction: float = 0.5,
+        shrink_fraction: float = 0.15,
+        grow_floor_window: float = 0.02,
+        idle_grow_guard: float = 0.5,
+        now=time.monotonic,
+    ) -> None:
+        if slo_p99_seconds <= 0:
+            raise ValueError("slo_p99_seconds must be positive")
+        self.slo = slo_p99_seconds
+        self.min_window = max(0.0, min_window)
+        # the window is spent INSIDE the latency budget; cap it at half
+        # the SLO so batching alone can never burn the whole budget
+        cap = 0.5 * slo_p99_seconds
+        self.max_window = min(
+            cap, max_window if max_window is not None else 0.25
+        )
+        self.max_window = max(self.max_window, self.min_window)
+        self.max_batch = max(BATCH_BUCKET, int(max_batch))
+        lb = min(int(latency_batch), self.max_batch)
+        self.latency_batch = max(
+            BATCH_BUCKET,
+            BATCH_BUCKET * (lb // BATCH_BUCKET),
+        )
+        self.interval = interval_seconds
+        self.grow_fraction = grow_fraction
+        self.shrink_fraction = shrink_fraction
+        self.grow_floor_window = max(grow_floor_window, 1e-4)
+        self.idle_grow_guard = idle_grow_guard
+        self._now = now
+
+        # controller outputs (read by the dispatcher every batch)
+        self.window = self.min_window
+        self.batch_cap = self.latency_batch
+
+        # trajectory / oscillation visibility
+        self.steps = 0
+        self.window_changes = 0
+        self.cap_changes = 0
+        self.grows = 0
+        self.shrinks = 0
+
+        self._last_t: Optional[float] = None
+        self._last_cycle = 0
+        self._last_pop_wait = 0.0
+        self._last_step_t: Optional[float] = None
+
+    # -- the control law ----------------------------------------------------
+
+    def step(
+        self,
+        depth: int,
+        popped_cycle: int,
+        t: float,
+        pop_wait_seconds: Optional[float] = None,
+    ) -> str:
+        """One controller decision from (queue depth, cumulative pop
+        counter, clock, cumulative pop_wait stage seconds). Returns the
+        direction taken: "grow" | "shrink" | "hold". Pure in its inputs
+        + controller state -- no clock or RNG reads."""
+        self.steps += 1
+        if self._last_t is None:
+            self._last_t = t
+            self._last_cycle = popped_cycle
+            if pop_wait_seconds is not None:
+                self._last_pop_wait = pop_wait_seconds
+            return "hold"
+        dt = t - self._last_t
+        if dt <= 0:
+            return "hold"
+        rate = max(0.0, (popped_cycle - self._last_cycle) / dt)
+        idle_frac = 0.0
+        if pop_wait_seconds is not None:
+            idle_frac = max(
+                0.0, min(1.0, (pop_wait_seconds - self._last_pop_wait) / dt)
+            )
+            self._last_pop_wait = pop_wait_seconds
+        self._last_t = t
+        self._last_cycle = popped_cycle
+
+        if rate > 0:
+            wait_est = depth / rate
+        else:
+            # nothing drained this interval: a backlog with no drain is
+            # saturation (estimate pins to the SLO, forcing a grow); an
+            # empty queue with no drain is plain idle
+            wait_est = self.slo if depth > 0 else 0.0
+        pressure = wait_est / self.slo
+
+        if pressure > self.grow_fraction and idle_frac < self.idle_grow_guard:
+            return self._apply("grow", self._grown())
+        if (
+            pressure < self.shrink_fraction
+            and depth <= self.latency_batch
+        ):
+            return self._apply("shrink", self._shrunk())
+        return "hold"
+
+    def _grown(self):
+        window = min(
+            self.max_window, max(self.grow_floor_window, self.window * 2.0)
+        )
+        return window, self.max_batch
+
+    def _shrunk(self):
+        if self.window <= self.grow_floor_window:
+            window = self.min_window
+        else:
+            window = max(self.min_window, self.window / 2.0)
+        return window, self.latency_batch
+
+    def _apply(self, direction: str, target) -> str:
+        window, cap = target
+        changed = False
+        if window != self.window:
+            self.window = window
+            self.window_changes += 1
+            changed = True
+        if cap != self.batch_cap:
+            self.batch_cap = cap
+            self.cap_changes += 1
+            changed = True
+        if not changed:
+            # already pinned at the pole: not a decision, not a change
+            return "hold"
+        if direction == "grow":
+            self.grows += 1
+        else:
+            self.shrinks += 1
+        metrics.autobatch_decisions.inc(direction=direction)
+        metrics.autobatch_window.set(self.window)
+        metrics.autobatch_batch_cap.set(float(self.batch_cap))
+        return direction
+
+    # -- dispatcher-facing wrapper -------------------------------------------
+
+    def maybe_step(self, sched) -> Optional[str]:
+        """Time-gated poll from the dispatch loop: at most one decision
+        per ``interval_seconds``, reading the live queue + stage-timer
+        signals and pushing the outputs onto the scheduler
+        (``batch_window``, ``dispatch_batch_cap``, ``solve_pad``)."""
+        t = self._now()
+        if (
+            self._last_step_t is not None
+            and t - self._last_step_t < self.interval
+        ):
+            return None
+        self._last_step_t = t
+        direction = self.step(
+            sched.queue.active_count(),
+            sched.queue.scheduling_cycle,
+            t,
+            sched.stage_seconds.get("pop_wait", 0.0),
+        )
+        sched.batch_window = self.window
+        sched.dispatch_batch_cap = self.batch_cap
+        sched.solve_pad = self.batch_cap
+        return direction

@@ -358,6 +358,42 @@ degraded_health = registry.register(Gauge(
     "1 when a component is operating degraded, by reason.",
     ("reason",),
 ))
+# open-loop streaming subsystem (kubernetes_tpu/streaming/): the
+# SLO-adaptive batch controller, priority bands, and arrival-engine
+# backpressure must be observable -- a controller that thrashes or an
+# engine that stalls is a capacity signal, not an implementation detail
+autobatch_decisions = registry.register(Counter(
+    "scheduler_autobatch_decisions_total",
+    "SLO-adaptive batch controller decisions that changed the window "
+    "or dispatch cap, by direction (grow = throughput mode, shrink = "
+    "latency mode).",
+    ("direction",),
+))
+autobatch_window = registry.register(Gauge(
+    "scheduler_autobatch_window_seconds",
+    "Current adaptive batch window.",
+))
+autobatch_batch_cap = registry.register(Gauge(
+    "scheduler_autobatch_batch_cap",
+    "Current adaptive dispatch cap (pods per pop_batch drain; also "
+    "floors the padded solve shape).",
+))
+queue_band_wait = registry.register(Histogram(
+    "scheduler_queue_band_wait_seconds",
+    "ActiveQ wait (enqueue to drain) by priority band; only recorded "
+    "when band_threshold is set.",
+    ("band",),
+))
+backpressure_stalls = registry.register(Counter(
+    "scheduler_arrival_backpressure_stalls_total",
+    "Times the open-loop arrival engine stalled because the activeQ "
+    "depth hit its bound (offered rate exceeded capacity).",
+))
+backpressure_stall_seconds = registry.register(Counter(
+    "scheduler_arrival_backpressure_stall_seconds_total",
+    "Cumulative wall clock the arrival engine spent stalled on the "
+    "activeQ depth gate.",
+))
 
 
 class SinceTimer:

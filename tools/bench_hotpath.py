@@ -1,5 +1,6 @@
-"""Host-stage microbenchmarks: queue drain, pack, commit gather/assume,
-node-state delta update + reuse check.
+"""Host-stage microbenchmarks: queue drain (flat + band-aware), pack,
+commit gather/assume, node-state delta update + reuse check, and the
+streaming subsystem's controller step + trace generation.
 
 The end-to-end bench (bench.py) measures the pipeline; this tool
 isolates the host stages PR 4/PR 5 vectorized so a regression in any
@@ -87,6 +88,54 @@ def bench_queue_drain(pods, batch):
     perpod_ms = (time.perf_counter() - t0) * 1000
     assert got == len(pods), f"per-pod drain lost pods: {got}/{len(pods)}"
     return bulk_ms, perpod_ms
+
+
+def bench_band_drain(pods, batch):
+    """The band-aware drain vs the flat drain on the same backlog: the
+    per-drained-pod band check + wait histogram must stay in the noise
+    (pods carry mixed priorities, so both bands are exercised)."""
+    q, _ = _make_queue(pods)
+    q.band_threshold = 2  # priority(i % 3): ~1/3 of pods are high band
+    t0 = time.perf_counter()
+    got = 0
+    while got < len(pods):
+        out = q.pop_batch(batch, timeout=0.0)
+        if not out:
+            break
+        got += len(out)
+    band_ms = (time.perf_counter() - t0) * 1000
+    assert got == len(pods), f"band drain lost pods: {got}/{len(pods)}"
+    return band_ms
+
+
+def bench_controller_step(n_steps=10000):
+    """The SLO-adaptive controller's decision cost: it runs once per
+    controller interval on the dispatcher thread, so a step must be
+    microseconds. Synthetic signal walks depth up and down so both
+    poles and the hysteresis band are visited."""
+    from kubernetes_tpu.streaming.autobatch import AutoBatchController
+
+    c = AutoBatchController(slo_p99_seconds=1.0, max_batch=4096)
+    t0 = time.perf_counter()
+    cycle = 0
+    for i in range(n_steps):
+        depth = (i * 37) % 9000
+        cycle += 400
+        c.step(depth, cycle, 0.25 * (i + 1), pop_wait_seconds=0.01 * i)
+    total = time.perf_counter() - t0
+    return total / n_steps * 1e6  # us per step
+
+
+def bench_arrivals_gen(rate=10000.0, duration=10.0):
+    """Trace generation cost for a 100k-arrival Poisson trace (runs
+    once per bench step, off the clock -- recorded for scale)."""
+    from kubernetes_tpu.streaming.arrivals import poisson_trace
+
+    t0 = time.perf_counter()
+    offsets = poisson_trace(rate, duration, seed=0)
+    ms = (time.perf_counter() - t0) * 1000
+    assert offsets.size > 0
+    return ms, int(offsets.size)
 
 
 def bench_pack(pods):
@@ -309,6 +358,9 @@ def main() -> None:
     node_names = [f"node-{i}" for i in range(args.nodes)]
 
     drain_ms, drain_perpod_ms = bench_queue_drain(pods, args.batch)
+    band_drain_ms = bench_band_drain(pods, args.batch)
+    controller_step_us = bench_controller_step()
+    arrivals_gen_ms, arrivals_n = bench_arrivals_gen()
     pack_ms = bench_pack(pods)
     gather_ms, assume_ms = bench_commit(pods, node_names)
     node_state = bench_node_state(args.nodes)
@@ -320,6 +372,12 @@ def main() -> None:
         "nodes": args.nodes,
         "queue_drain_ms": round(drain_ms, 2),
         "queue_drain_perpod_ms": round(drain_perpod_ms, 2),
+        # streaming subsystem (PR 7): band-aware drain vs flat drain,
+        # controller decision cost, trace generation for scale
+        "queue_drain_band_ms": round(band_drain_ms, 2),
+        "controller_step_us": round(controller_step_us, 3),
+        "arrivals_gen_ms": round(arrivals_gen_ms, 2),
+        "arrivals_gen_count": arrivals_n,
         "pack_ms": round(pack_ms, 2),
         "commit_gather_ms": round(gather_ms, 2),
         "commit_assume_ms": round(assume_ms, 2),
