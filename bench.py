@@ -65,6 +65,7 @@ class BindWatcher:
     (util.go:197), but event-driven instead of 1s polling."""
 
     def __init__(self, server, target_names=None) -> None:
+        self._server = server
         self._watch = server.watch("Pod", since_rv=server.current_rv())
         self.bind_times = {}
         self._lock = threading.Lock()
@@ -80,7 +81,28 @@ class BindWatcher:
 
     def _run(self) -> None:
         while not self._stop:
-            evs = self._watch.next_batch(timeout=0.2)
+            try:
+                evs = self._watch.next_batch(timeout=0.2)
+            except Exception:  # noqa: BLE001 - lagged past the watch
+                # history trim (410 Gone): relist-and-diff so binds
+                # that landed in the gap are still counted, and reopen
+                # from the listed rv -- a dead watcher thread would
+                # deadlock the whole bench on its completion wait
+                pods, rv = self._server.list("Pod")
+                self._watch = self._server.watch("Pod", since_rv=rv)
+                now = time.perf_counter()
+                with self._cond:
+                    for pod in pods:
+                        name = pod.metadata.name
+                        if pod.spec.node_name and (
+                            name not in self.bind_times
+                        ):
+                            self.bind_times[name] = now
+                            if name in self._targets:
+                                self._outstanding -= 1
+                    if self._outstanding <= 0:
+                        self._cond.notify_all()
+                continue
             if not evs:
                 continue
             now = time.perf_counter()
@@ -261,6 +283,151 @@ def run_ha_chaos_bench(fault_seed: int) -> None:
 
 
 OPEN_LOOP_POLICIES = ("adaptive", "latency-static", "throughput-static")
+
+
+def soak_once(
+    *,
+    rate: float,
+    duration_s: float,
+    bucket_s: float,
+    slo_s: float,
+    num_nodes: int,
+    max_batch: int,
+    trace_seed: int = 0,
+    period_s: float = 0.0,
+) -> dict:
+    """One soak run (importable: the tier-1-visible `slow` test drives a
+    miniature one through the same code): a diurnal arrival trace
+    replayed open-loop through the SLO-adaptive stack, scored as
+    **SLO-violation-minutes** -- wall-clock buckets whose p99
+    pod-to-bind latency blew the budget, or whose arrivals never bound
+    at all. A long soak's honest failure metric is TIME spent out of
+    SLO, not a single end-of-run percentile that averages the diurnal
+    peak against the trough."""
+    from kubernetes_tpu.streaming.arrivals import ArrivalEngine, load_trace
+    from kubernetes_tpu.testing import make_pod
+
+    server, client, informers, sched, controller = _open_loop_stack(
+        num_nodes, max_batch, "adaptive", slo_s
+    )
+    sched.warmup()
+    warm = [
+        make_pod(f"soakwarm-{i}").container(cpu="100m", memory="128Mi").obj()
+        for i in range(min(256, max_batch))
+    ]
+    warm_watch = BindWatcher(server, [p.metadata.name for p in warm])
+    for p in warm:
+        client.create_pod(p)
+    sched.start()
+    warm_ok = warm_watch.wait_for_targets(time.time() + 600)
+    warm_watch.stop()
+    sched.wait_for_inflight_binds(timeout=60)
+    if not warm_ok:
+        sched.stop()
+        informers.stop()
+        return {"error": "warmup incomplete", "slo_violation_minutes": -1.0}
+
+    offsets = load_trace(
+        "diurnal", rate, duration_s, seed=trace_seed,
+        period=period_s or max(20.0, duration_s / 3.0),
+    )
+    names = [f"soak-{i}" for i in range(len(offsets))]
+    watcher = BindWatcher(server, names)
+
+    def factory(i):
+        return (
+            make_pod(f"soak-{i}")
+            .container(cpu="100m", memory="128Mi").obj()
+        )
+
+    depth_bound = max(4 * sched.max_batch, int(2 * rate * slo_s))
+    engine = ArrivalEngine(
+        client, offsets, factory,
+        depth_fn=sched.queue.active_count,
+        max_queue_depth=depth_bound,
+    )
+    t0 = time.perf_counter()
+    engine.start()
+    deadline = time.time() + duration_s + max(60.0, 20 * slo_s)
+    completed = watcher.wait_for_targets(deadline)
+    engine.stop()
+    sched.wait_for_inflight_binds(timeout=60)
+    watcher.stop()
+
+    # score per wall-clock bucket: a bucket violates when the p99 of
+    # pods ARRIVING in it exceeded the budget, or any of its arrivals
+    # never bound
+    n_buckets = max(1, int(-(-duration_s // bucket_s)))
+    buckets = [[] for _ in range(n_buckets)]
+    unbound = [0] * n_buckets
+    for i, name in enumerate(names):
+        b = min(n_buckets - 1, int(offsets[i] // bucket_s))
+        bind_t = watcher.bind_times.get(name)
+        created = engine.created_ts.get(name)
+        if bind_t is None or created is None:
+            unbound[b] += 1
+            continue
+        buckets[b].append(bind_t - created)
+
+    def p99(vals):
+        if not vals:
+            return 0.0
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, (len(vals) * 99) // 100)]
+
+    per_bucket = []
+    violated = 0
+    for b in range(n_buckets):
+        bp99 = p99(buckets[b])
+        bad = bool(unbound[b]) or (bool(buckets[b]) and bp99 > slo_s)
+        violated += bad
+        per_bucket.append({
+            "bucket": b,
+            "pods": len(buckets[b]) + unbound[b],
+            "unbound": unbound[b],
+            "p99_ms": round(bp99 * 1000, 1),
+            "violated": bad,
+        })
+    elapsed = time.perf_counter() - t0
+    sched.stop()
+    informers.stop()
+    record = {
+        "metric": "soak_slo_violation_minutes",
+        "value": round(violated * bucket_s / 60.0, 3),
+        "unit": "minutes",
+        "slo_violation_minutes": round(violated * bucket_s / 60.0, 3),
+        "violated_buckets": violated,
+        "buckets": per_bucket,
+        "bucket_seconds": bucket_s,
+        "completed": bool(completed),
+        "pods": len(names),
+        "bound": len(watcher.bind_times),
+        "backpressure_stalls": engine.backpressure_stalls,
+        "rate": rate,
+        "duration_seconds": duration_s,
+        "slo_p99_ms": slo_s * 1000,
+        "nodes": num_nodes,
+        "elapsed_s": round(elapsed, 1),
+        "controller_latched": getattr(controller, "latches", 0),
+    }
+    return record
+
+
+def run_soak_bench(args) -> None:
+    """--mode soak (ROADMAP item-2 residual c): hours-scale diurnal
+    runs, reported as SLO-violation-minutes. Env knobs: SOAK_RATE
+    (pods/s, default 600), SOAK_DURATION_S (default 120), SOAK_BUCKET_S
+    (default 60), BENCH_NODES (default 2000), BENCH_BATCH."""
+    record = soak_once(
+        rate=float(os.environ.get("SOAK_RATE", 600.0)),
+        duration_s=float(os.environ.get("SOAK_DURATION_S", 120.0)),
+        bucket_s=float(os.environ.get("SOAK_BUCKET_S", 60.0)),
+        slo_s=args.slo_p99_ms / 1000.0,
+        num_nodes=int(os.environ.get("BENCH_NODES", 2000)),
+        max_batch=int(os.environ.get("BENCH_BATCH", 4096)),
+        trace_seed=args.trace_seed,
+    )
+    print(json.dumps(record))
 
 
 def _open_loop_stack(num_nodes, max_batch, policy, slo_s):
@@ -518,6 +685,178 @@ def run_open_loop_bench(args) -> None:
     print(json.dumps(record))
 
 
+def run_partitioned_burst(args) -> None:
+    """--partitions N: the closed-loop burst through N ACTIVE partitioned
+    scheduler stacks (scheduler/partition.py) over ONE apiserver -- the
+    horizontal scale-out headline. Each stack owns a node-space slice
+    (its tensors are ~N/P rows) and the pods split by uid hash, so the
+    comparison against --partitions 1 on the same box isolates what the
+    partitioned control plane buys (and what the shared apiserver
+    costs). With --fault-profile partition-chaos the seeded chaos
+    (lease losses, conflict bursts, api blips) runs over the burst and
+    the record carries the conflict ledger + takeover counters."""
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.config.types import (
+        KubeSchedulerConfiguration,
+        PartitionConfiguration,
+    )
+    from kubernetes_tpu.robustness.faults import (
+        FaultInjector,
+        install_injector,
+        load_profile,
+    )
+    from kubernetes_tpu.scheduler.app import SchedulerApp
+    from kubernetes_tpu.testing import make_node, make_pod
+    from kubernetes_tpu.utils import metrics
+
+    num_nodes = int(os.environ.get("BENCH_NODES", 5000))
+    num_pods = int(os.environ.get("BENCH_PODS", 10000))
+    max_batch = int(os.environ.get("BENCH_BATCH", 4096))
+    n_parts = max(1, args.partitions)
+
+    server = APIServer()
+
+    def cfg():
+        c = KubeSchedulerConfiguration(
+            partition=PartitionConfiguration(
+                enabled=True, num_partitions=n_parts,
+                # generous leases: a saturated box (the burst IS
+                # saturation) can starve renew threads for seconds, and
+                # a lapsed lease mid-burst turns the measurement into a
+                # takeover storm (every commit fencing) instead of a
+                # throughput number. Real takeover latency is measured
+                # by the chaos harness, not here.
+                lease_duration_seconds=10.0, retry_period_seconds=1.0,
+            )
+        )
+        c.tpu_solver.max_batch = max_batch
+        return c
+
+    apps = [SchedulerApp(config=cfg(), server=server) for _ in range(n_parts)]
+    client = apps[0].client
+    for i in range(num_nodes):
+        client.create_node(
+            make_node(f"node-{i}")
+            .capacity(cpu="32", memory="64Gi", pods=110).obj()
+        )
+    # jit caches are process-global: one warmup compiles for every stack
+    for app in apps:
+        app.sched.max_batch = max_batch
+    apps[0].sched.warmup()
+    for app in apps:
+        app.start()
+    # settle: every partition claimed by exactly one stack
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        held = sorted(
+            k for app in apps for k in app.coordinator.held_partitions()
+        )
+        if held == list(range(n_parts)):
+            break
+        time.sleep(0.05)
+
+    warm = [
+        make_pod(f"warm-{i}").container(cpu="100m", memory="128Mi").obj()
+        for i in range(max_batch)
+    ]
+    warm_watch = BindWatcher(server, [p.metadata.name for p in warm])
+    client.create_pods_bulk(warm)
+    if not warm_watch.wait_for_targets(time.time() + 600):
+        print(json.dumps({
+            "metric": f"pods_per_sec_burst_p{n_parts}", "value": 0.0,
+            "unit": "pods/s", "error": "warmup did not complete",
+        }))
+        return
+    warm_watch.stop()
+    for app in apps:
+        app.sched.wait_for_inflight_binds(timeout=60)
+
+    fault_profile = ""
+    if args.fault_profile:
+        profile = load_profile(args.fault_profile, seed=args.fault_seed)
+        install_injector(FaultInjector(profile))
+        fault_profile = profile.name
+
+    num_trials = max(1, args.trials)
+    trials = []
+    err = None
+    for trial in range(num_trials + 1):
+        burst = [
+            make_pod(f"burst-t{trial}-{i}")
+            .container(cpu="250m", memory="512Mi").obj()
+            for i in range(num_pods)
+        ]
+        burst_names = {p.metadata.name for p in burst}
+        watcher = BindWatcher(server, burst_names)
+        start = time.perf_counter()
+        for i in range(0, num_pods, 256):
+            client.create_pods_bulk(burst[i:i + 256])
+        completed = watcher.wait_for_targets(time.time() + 600)
+        elapsed = time.perf_counter() - start
+        for app in apps:
+            app.sched.wait_for_inflight_binds(timeout=60)
+        watcher.stop()
+        bound = len([
+            n for n in watcher.bind_times if n in burst_names
+        ])
+        if not completed or bound < num_pods:
+            err = f"only {bound}/{num_pods} bound in trial {trial}"
+            break
+        rec = {
+            "trial": trial,
+            "pods_per_sec": round(num_pods / elapsed, 1),
+            "elapsed_s": round(elapsed, 3),
+        }
+        if trial == 0:
+            rec["discarded_warmup"] = True
+            print(json.dumps(rec), file=sys.stderr)
+            continue
+        trials.append(rec)
+    install_injector(None)
+
+    ledger = {
+        "bind_conflicts_absorbed": sum(
+            a.sched.bind_conflicts_absorbed for a in apps
+        ),
+        "conflict_requeues": sum(a.sched.conflict_requeues for a in apps),
+        "conflict_stale_binds": sum(
+            a.sched.conflict_stale_binds for a in apps
+        ),
+        "pods_spilled": sum(a.sched.pods_spilled for a in apps),
+        "partition_takeovers": sum(a.coordinator.takeovers for a in apps),
+    }
+    for app in apps:
+        app.stop()
+    if err or not trials:
+        print(json.dumps({
+            "metric": f"pods_per_sec_burst_p{n_parts}", "value": 0.0,
+            "unit": "pods/s", "error": err or "no trials",
+            **ledger,
+        }))
+        return
+    median = pick_median_trial(trials)
+    record = {
+        "metric": (
+            f"pods_per_sec_"
+            f"{f'{num_pods//1000}k' if num_pods >= 1000 else num_pods}"
+            f"_burst_{num_nodes}_nodes_p{n_parts}"
+        ),
+        "value": median["pods_per_sec"],
+        "unit": "pods/s",
+        "vs_baseline": round(
+            median["pods_per_sec"] / BASELINE_PODS_PER_SEC, 2
+        ),
+        "partitions": n_parts,
+        "median_trial": median["trial"],
+        "trials": trials,
+        "fencing_aborts": metrics.fencing_aborts.value(),
+        **ledger,
+    }
+    if fault_profile:
+        record["fault_profile"] = fault_profile
+    print(json.dumps(record))
+
+
 def pick_median_trial(trials):
     """The headline trial: median by throughput (even counts round to
     the LOWER middle, i.e. the more conservative of the two)."""
@@ -626,10 +965,20 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "--mode", default=os.environ.get("BENCH_MODE", "burst"),
-        choices=("burst", "open-loop"),
+        choices=("burst", "open-loop", "soak"),
         help="burst = the closed-loop drain bench; open-loop = an "
         "arrival PROCESS replayed through an offered-rate ladder, "
-        "reporting sustained pods/s at a fixed p99 pod-to-bind budget",
+        "reporting sustained pods/s at a fixed p99 pod-to-bind budget; "
+        "soak = a long diurnal run reporting SLO-violation-minutes "
+        "(env SOAK_RATE / SOAK_DURATION_S / SOAK_BUCKET_S)",
+    )
+    ap.add_argument(
+        "--partitions", type=int,
+        default=int(os.environ.get("BENCH_PARTITIONS", 1)),
+        help="run the burst through N ACTIVE partitioned scheduler "
+        "stacks over one apiserver (scheduler/partition.py); 1 = the "
+        "classic single stack. Compare N vs 1 on the same box for the "
+        "horizontal scale-out headline",
     )
     ap.add_argument(
         "--trace", default=os.environ.get("OPEN_LOOP_TRACE", "poisson"),
@@ -702,8 +1051,16 @@ def main() -> None:
         run_ha_chaos_bench(args.fault_seed)
         return
 
+    if args.mode == "soak":
+        run_soak_bench(args)
+        return
+
     if args.mode == "open-loop":
         run_open_loop_bench(args)
+        return
+
+    if args.partitions > 1:
+        run_partitioned_burst(args)
         return
 
     num_nodes = int(os.environ.get("BENCH_NODES", 5000))

@@ -576,6 +576,26 @@ class Binding:
 
 
 @dataclass
+class PriorityClass:
+    """scheduling.k8s.io/v1 PriorityClass: a named priority value.
+    Pods reference one by ``spec.priority_class_name``; the admission
+    classifier resolves the effective priority from it when
+    ``spec.priority`` was not stamped, and the streaming band threshold
+    can be selected by a PriorityClass OBJECT instead of a raw integer
+    (config streaming.bandPriorityClass)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    global_default: bool = False
+    description: str = ""
+
+    kind: str = "PriorityClass"
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+@dataclass
 class Lease:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     holder_identity: str = ""

@@ -20,7 +20,7 @@ mutating (the same contract client-go informer caches impose).
 from __future__ import annotations
 
 import threading
-from collections import deque
+from bisect import bisect_right
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -62,6 +62,27 @@ class Conflict(ValueError):
     pass
 
 
+class BindConflict(Conflict):
+    """A typed bind conflict: the optimistic-concurrency answer of the
+    multi-active control plane, NOT a transport failure. ``kind`` names
+    the shape so the committer can absorb it through the requeue path
+    (and the conflict ledger can account for it):
+
+    - ``already-bound``: the pod is bound to a different node (a sibling
+      stack won the race, or a takeover re-bind raced the original);
+    - ``uid-mismatch``: the pod was deleted and recreated under the same
+      key (a new incarnation -- the binding targeted the old one);
+    - ``foreign-partition``: the binder's partition lease over the
+      target node is held live by another stack (the server-side half of
+      the commit fence, checked under the store lock)."""
+
+    def __init__(self, message: str, kind: str = "already-bound",
+                 current_node: str = "") -> None:
+        super().__init__(message)
+        self.kind = kind
+        self.current_node = current_node
+
+
 class Gone(Exception):
     """410 Gone analogue (apiserver storage.NewTooLargeResourceVersionError
     inverse): the requested since_rv predates the oldest retained watch
@@ -87,65 +108,98 @@ class WatchEvent:
 
 
 class Watch:
-    """One client watch stream.
+    """One client watch stream: a CURSOR into the kind's shared event
+    log, not a private mailbox.
 
-    Events land in a deque under a Condition; producers can deliver in
-    bulk (one lock round trip per transaction instead of per event) and
-    consumers can drain in bulk (``next_batch``) -- the in-proc analogue
-    of the reference's HTTP/2 watch stream frames carrying many events
-    per read.
+    The original design delivered every event into a per-watch deque --
+    one lock round trip and one copy per event PER WATCHER, so N active
+    scheduler stacks multiplied the in-process fan-out cost of every
+    store transaction by N (the event loop cost ROADMAP item 4 calls
+    out). Here producers append to the kind's bounded history ONCE
+    (which replay already required) and notify a per-kind condition;
+    each watcher drains ``history[cursor:]`` in batches on its own
+    schedule. Broadcast is O(events), independent of watcher count
+    (tools/bench_hotpath.py ``watch_fanout_*`` pins this).
+
+    A watcher that lags so far that the history trim passes its cursor
+    raises ``Gone`` on the next read -- exactly the 410 semantics a
+    reconnecting watcher already handles (informers relist+diff).
     """
 
-    def __init__(self, server: "APIServer", kind: str):
+    __slots__ = ("_server", "kind", "_cursor", "stopped")
+
+    def __init__(self, server: "APIServer", kind: str, cursor: int):
         self._server = server
         self.kind = kind
-        self._items: "deque[WatchEvent]" = deque()
-        self._cond = threading.Condition()
+        #: absolute event ordinal (monotone per kind, survives trims)
+        self._cursor = cursor
         self.stopped = False
 
-    def _deliver(self, event: WatchEvent) -> None:
-        with self._cond:
-            self._items.append(event)
-            self._cond.notify()
+    def _drain_locked(self) -> List[WatchEvent]:
+        """Caller holds the kind condition."""
+        srv = self._server
+        base = srv._history_base[self.kind]
+        hist = srv._history[self.kind]
+        if self._cursor < base:
+            raise Gone(
+                f"{self.kind} watch lagged past the history trim "
+                f"(cursor {self._cursor} < base {base}); relist"
+            )
+        idx = self._cursor - base
+        out = hist[idx:] if idx < len(hist) else []
+        self._cursor = base + len(hist)
+        return list(out)
 
-    def _deliver_many(self, events: List[WatchEvent]) -> None:
-        with self._cond:
-            self._items.extend(events)
-            self._cond.notify()
+    def _has_pending_locked(self) -> bool:
+        srv = self._server
+        return (
+            self._cursor
+            < srv._history_base[self.kind] + len(srv._history[self.kind])
+        )
 
     def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
         """Next event, or None on stop/timeout."""
-        with self._cond:
-            if not self._items and not self.stopped:
-                self._cond.wait(timeout)
-            if not self._items:
+        cond = self._server._kind_conds[self.kind]
+        with cond:
+            if not self._has_pending_locked() and not self.stopped:
+                cond.wait(timeout)
+            srv = self._server
+            base = srv._history_base[self.kind]
+            hist = srv._history[self.kind]
+            if self._cursor < base:
+                raise Gone(
+                    f"{self.kind} watch lagged past the history trim"
+                )
+            idx = self._cursor - base
+            if idx >= len(hist):
                 return None
-            return self._items.popleft()
+            self._cursor += 1
+            return hist[idx]
 
     def next_batch(
         self, timeout: Optional[float] = None
     ) -> List[WatchEvent]:
         """Block for at least one event (or stop/timeout), then drain
         everything pending."""
-        with self._cond:
-            if not self._items and not self.stopped:
-                self._cond.wait(timeout)
-            out = list(self._items)
-            self._items.clear()
-            return out
+        cond = self._server._kind_conds[self.kind]
+        with cond:
+            if not self._has_pending_locked() and not self.stopped:
+                cond.wait(timeout)
+            return self._drain_locked()
 
     def pending(self) -> List[WatchEvent]:
         """Drain without blocking (used by the synchronous pump mode)."""
-        with self._cond:
-            out = list(self._items)
-            self._items.clear()
-            return out
+        cond = self._server._kind_conds[self.kind]
+        with cond:
+            return self._drain_locked()
 
     def stop(self) -> None:
         self._server._remove_watch(self)
-        with self._cond:
-            self.stopped = True
-            self._cond.notify_all()
+        cond = self._server._kind_conds.get(self.kind)
+        self.stopped = True
+        if cond is not None:
+            with cond:
+                cond.notify_all()
 
 
 def _obj_key(obj: Any) -> Tuple[str, str]:
@@ -162,7 +216,7 @@ class APIServer:
         "Pod", "Node", "PodDisruptionBudget", "PodGroup", "Lease", "Service",
         "PersistentVolume", "PersistentVolumeClaim", "StorageClass",
         "CSINode", "ReplicationController", "ReplicaSet", "StatefulSet",
-        "Secret",
+        "Secret", "PriorityClass",
     )
 
     def __init__(self, watch_history_limit: int = 200_000) -> None:
@@ -171,20 +225,39 @@ class APIServer:
         self._stores: Dict[str, Dict[Tuple[str, str], Any]] = {
             k: {} for k in self.KINDS
         }
-        self._watches: Dict[str, List[Watch]] = {k: [] for k in self.KINDS}
-        # bounded per-kind event history for watch(since_rv) replay
+        # the shared per-kind event log IS the watch fan-out: watchers
+        # hold cursors into it (see Watch), so broadcast is O(events)
+        # regardless of watcher count. `_history_base[kind]` is the
+        # absolute ordinal of history[0] (bumped by trims, so cursors
+        # survive them); `_kind_conds` serializes log mutation against
+        # watcher reads without the store lock.
         self._history: Dict[str, List[WatchEvent]] = {k: [] for k in self.KINDS}
+        self._history_base: Dict[str, int] = {k: 0 for k in self.KINDS}
+        self._kind_conds: Dict[str, threading.Condition] = {
+            k: threading.Condition() for k in self.KINDS
+        }
         self._history_limit = watch_history_limit
         # highest rv ever trimmed out of a kind's history: a watch asking
         # to replay from below this would silently miss events -> Gone
         self._history_trunc_rv: Dict[str, int] = {k: 0 for k in self.KINDS}
+        # multi-active partitioned scheduling (scheduler/partition.py):
+        # when installed, bulk binds carrying a binder identity are
+        # checked against the live partition leases under the store lock
+        self._partition_authority = None
 
     def _ensure_kind(self, kind: str) -> None:
         if kind not in self._stores:
             self._stores[kind] = {}
-            self._watches[kind] = []
             self._history[kind] = []
+            self._history_base[kind] = 0
+            self._kind_conds[kind] = threading.Condition()
             self._history_trunc_rv[kind] = 0
+
+    def install_partition_authority(self, authority) -> None:
+        """Install the server-side partition bind fence (an object with
+        ``check(binder, node_name) -> Optional[str]``); None clears."""
+        with self._lock:
+            self._partition_authority = authority
 
     # -- core ---------------------------------------------------------------
 
@@ -192,31 +265,39 @@ class APIServer:
         self._rv += 1
         return self._rv
 
-    def _trim_history(self, kind: str, hist: List[WatchEvent]) -> None:
+    def _trim_history_locked(self, kind: str, hist: List[WatchEvent]) -> None:
+        """Caller holds the kind condition."""
         if len(hist) > self._history_limit:
             cut = len(hist) // 2
             # record the highest discarded rv so watch(since_rv) can
-            # detect a replay gap instead of silently skipping it
+            # detect a replay gap instead of silently skipping it, and
+            # advance the base so live cursors keep their meaning (a
+            # cursor below the new base is Gone on its next read)
             self._history_trunc_rv[kind] = hist[cut - 1].resource_version
+            self._history_base[kind] += cut
             del hist[:cut]
 
     def _broadcast(self, kind: str, event: WatchEvent) -> None:
-        hist = self._history[kind]
-        hist.append(event)
-        self._trim_history(kind, hist)
-        for w in list(self._watches[kind]):
-            w._deliver(event)
+        cond = self._kind_conds[kind]
+        with cond:
+            hist = self._history[kind]
+            hist.append(event)
+            self._trim_history_locked(kind, hist)
+            cond.notify_all()
 
     def _broadcast_many(self, kind: str, events: List[WatchEvent]) -> None:
-        """One history extend + one per-watch lock round trip for a whole
-        transaction's worth of events (the bulk-bind fan-out path)."""
+        """One log extend + ONE wakeup for a whole transaction's worth
+        of events: watchers drain the log in batches, so the per-event
+        cost no longer scales with the watcher count (the bulk-bind
+        fan-out path under N active stacks)."""
         if not events:
             return
-        hist = self._history[kind]
-        hist.extend(events)
-        self._trim_history(kind, hist)
-        for w in list(self._watches[kind]):
-            w._deliver_many(events)
+        cond = self._kind_conds[kind]
+        with cond:
+            hist = self._history[kind]
+            hist.extend(events)
+            self._trim_history_locked(kind, hist)
+            cond.notify_all()
 
     def current_rv(self) -> int:
         with self._lock:
@@ -386,30 +467,35 @@ class APIServer:
                     f"{self._history_trunc_rv[kind]}; cannot replay from "
                     f"{since_rv}"
                 )
-            w = Watch(self, kind)
-            for ev in self._history[kind]:
-                if ev.resource_version > since_rv:
-                    w._deliver(ev)
-            self._watches[kind].append(w)
-            return w
+            # cursor = first retained event with rv > since_rv (the
+            # kind's rv sequence is monotone, so bisect positions the
+            # replay start without scanning)
+            cond = self._kind_conds[kind]
+            with cond:
+                hist = self._history[kind]
+                rvs = [ev.resource_version for ev in hist]
+                idx = bisect_right(rvs, since_rv)
+                cursor = self._history_base[kind] + idx
+            return Watch(self, kind, cursor)
 
     def _remove_watch(self, w: Watch) -> None:
-        with self._lock:
-            try:
-                self._watches[w.kind].remove(w)
-            except ValueError:
-                pass
+        pass  # cursors hold no server-side state to unregister
 
     # -- pods/binding subresource (storage.go:159 BindingREST.Create) -------
 
-    def _bind_locked(self, binding: Binding) -> Tuple[Pod, bool]:
+    def _bind_locked(
+        self, binding: Binding, binder: Optional[str] = None
+    ) -> Tuple[Pod, bool]:
         """Validate + apply one binding; caller holds the store lock.
         Returns (pod, changed) and appends nothing -- the caller decides
         how to fan out the watch event (single vs bulk delivery).
         ``changed`` is False when the pod was ALREADY bound to the same
         node: a retried commit whose first attempt actually landed (or a
         restarted scheduler re-driving a recovered placement) is
-        idempotent success, not a conflict -- no write, no event."""
+        idempotent success, not a conflict -- no write, no event.
+        Conflicts raise TYPED ``BindConflict``s so a multi-active
+        committer can absorb them through the requeue path instead of
+        treating them as scheduler errors."""
         store = self._stores["Pod"]
         old: Optional[Pod] = store.get(
             (binding.pod_namespace, binding.pod_name)
@@ -419,18 +505,30 @@ class APIServer:
                 f"Pod {binding.pod_namespace}/{binding.pod_name} not found"
             )
         if binding.pod_uid and old.metadata.uid != binding.pod_uid:
-            raise Conflict(
+            raise BindConflict(
                 f"pod {old.key()} uid mismatch: binding has "
-                f"{binding.pod_uid}, pod has {old.metadata.uid}"
+                f"{binding.pod_uid}, pod has {old.metadata.uid}",
+                kind="uid-mismatch",
             )
         if old.spec.node_name:
             if old.spec.node_name == binding.target_node:
                 return old, False
-            raise Conflict(
-                f"pod {old.key()} is already bound to {old.spec.node_name}"
+            raise BindConflict(
+                f"pod {old.key()} is already bound to {old.spec.node_name}",
+                kind="already-bound",
+                current_node=old.spec.node_name,
             )
         if not binding.target_node:
             raise ValueError("binding.target_node is required")
+        auth = self._partition_authority
+        if auth is not None and binder is not None:
+            reason = auth.check(binder, binding.target_node)
+            if reason:
+                raise BindConflict(
+                    f"pod {old.key()}: binder {binder!r} does not own "
+                    f"the partition of node {binding.target_node!r}",
+                    kind=reason,
+                )
         # copy-on-write update (guaranteed_update semantics); the native
         # clone replaces a 4-deep copy.copy chain on the burst's hottest
         # store transaction (10k binds per measured window)
@@ -449,10 +547,10 @@ class APIServer:
         store[(binding.pod_namespace, binding.pod_name)] = pod
         return pod, True
 
-    def bind(self, binding: Binding) -> Pod:
+    def bind(self, binding: Binding, binder: Optional[str] = None) -> Pod:
         _api_unavailable_maybe()
         with self._lock:
-            pod, changed = self._bind_locked(binding)
+            pod, changed = self._bind_locked(binding, binder=binder)
             if changed:
                 self._broadcast(
                     "Pod",
@@ -461,7 +559,7 @@ class APIServer:
             return pod
 
     def bind_bulk(
-        self, bindings: List[Binding]
+        self, bindings: List[Binding], binder: Optional[str] = None
     ) -> List[Tuple[Optional[Pod], Optional[Exception]]]:
         """Pipelined bulk commit: all bindings validated and applied under
         ONE store transaction (the batch analogue of per-pod
@@ -469,14 +567,15 @@ class APIServer:
         abort the rest -- each slot returns (pod, None) or (None, error),
         mirroring N independent API calls minus N-1 lock round trips.
         Watch events for the whole transaction fan out in one bulk
-        delivery per watcher."""
+        delivery per watcher. ``binder`` identifies the committing stack
+        for the partition authority's server-side fence."""
         _api_unavailable_maybe()
         out: List[Tuple[Optional[Pod], Optional[Exception]]] = []
         events: List[WatchEvent] = []
         with self._lock:
             for binding in bindings:
                 try:
-                    pod, changed = self._bind_locked(binding)
+                    pod, changed = self._bind_locked(binding, binder=binder)
                     if changed:
                         events.append(
                             WatchEvent(
@@ -490,7 +589,7 @@ class APIServer:
         return out
 
     def bind_assumed_bulk(
-        self, assumed_pods: List[Pod]
+        self, assumed_pods: List[Pod], binder: Optional[str] = None
     ) -> List[Tuple[int, Exception]]:
         """Bulk bind commit driven directly by the scheduler's assumed
         clones (metadata carries namespace/name/uid, spec.node_name the
@@ -499,19 +598,54 @@ class APIServer:
         failed slots as (index, error); an empty list means every pod
         bound. The whole transaction runs under one store lock with one
         bulk watch fan-out, through the native C loop when available
-        (native/_hotpath.c bind_assumed_bulk)."""
+        (native/_hotpath.c bind_assumed_bulk).
+
+        ``binder`` arms the partition authority's server-side fence:
+        pods targeting a node whose partition lease is held live by a
+        DIFFERENT stack come back as typed ``foreign-partition``
+        conflicts. The check runs in Python BEFORE the native loop (the
+        loop stays partition-blind); surviving slots remap through
+        ``idx_map`` so error indexes stay caller-relative."""
         _api_unavailable_maybe()
         with self._lock:
+            pods = assumed_pods
+            idx_map: Optional[List[int]] = None
+            pre: List[Tuple[int, Exception]] = []
+            auth = self._partition_authority
+            if auth is not None and binder is not None:
+                allowed: List[Pod] = []
+                idx_map = []
+                verdict: Dict[str, Optional[str]] = {}
+                for i, a in enumerate(assumed_pods):
+                    node = a.spec.node_name
+                    reason = verdict.get(node, "")
+                    if reason == "":
+                        reason = auth.check(binder, node)
+                        verdict[node] = reason
+                    if reason:
+                        pre.append((i, BindConflict(
+                            f"pod {a.key()}: binder {binder!r} does not "
+                            f"own the partition of node {node!r}",
+                            kind=reason,
+                        )))
+                    else:
+                        allowed.append(a)
+                        idx_map.append(i)
+                pods = allowed
+
+            def caller_idx(i: int) -> int:
+                return idx_map[i] if idx_map is not None else i
+
             if _bind_assumed_bulk is not None:
                 errors, events, new_rv = _bind_assumed_bulk(
-                    self._stores["Pod"], assumed_pods, self._rv, WatchEvent
+                    self._stores["Pod"], pods, self._rv, WatchEvent
                 )
                 self._rv = new_rv
                 self._broadcast_many("Pod", events)
                 if not errors:
-                    return []
+                    return pre
                 store = self._stores["Pod"]
-                out: List[Tuple[int, Exception]] = []
+                out: List[Tuple[int, Exception]] = list(pre)
                 for idx, code, msg in errors:
                     exc: Exception
                     if code == 0:
@@ -522,7 +656,7 @@ class APIServer:
                         # scheduler re-driving a recovered placement):
                         # the C loop reports it as a conflict, but the
                         # store already holds exactly the requested state
-                        a = assumed_pods[idx]
+                        a = pods[idx]
                         cur = store.get(
                             (a.metadata.namespace, a.metadata.name)
                         )
@@ -532,16 +666,28 @@ class APIServer:
                             and cur.metadata.uid == a.metadata.uid
                         ):
                             continue
-                        exc = Conflict(msg)
+                        kind = (
+                            "uid-mismatch"
+                            if cur is not None
+                            and cur.metadata.uid != a.metadata.uid
+                            else "already-bound"
+                        )
+                        exc = BindConflict(
+                            msg, kind=kind,
+                            current_node=(
+                                cur.spec.node_name if cur is not None else ""
+                            ),
+                        )
                     elif code == 2:
                         exc = ValueError(msg)
                     else:
                         exc = RuntimeError(msg)
-                    out.append((idx, exc))
+                    out.append((caller_idx(idx), exc))
                 return out
             # pure-Python fallback: delegate to the shared bind_bulk
             # transaction (one loop to maintain) and convert its per-slot
-            # results to the failures-only shape
+            # results to the failures-only shape (the authority already
+            # ran above; don't pass binder down and double-check)
             results = self.bind_bulk(
                 [
                     Binding(
@@ -550,11 +696,12 @@ class APIServer:
                         pod_uid=a.metadata.uid,
                         target_node=a.spec.node_name,
                     )
-                    for a in assumed_pods
+                    for a in pods
                 ]
             )
-            return [
-                (i, err) for i, (_pod, err) in enumerate(results)
+            return pre + [
+                (caller_idx(i), err)
+                for i, (_pod, err) in enumerate(results)
                 if err is not None
             ]
 

@@ -341,6 +341,15 @@ class SchedulerCache:
                 for uid, state in self._pod_states.items()
             }
 
+    def pods_on_node(self, node_name: str) -> List[Pod]:
+        """Pods the cache accounts against one node (confirmed AND
+        assumed). The partition coordinator evicts these wholesale when
+        a partition is handed off -- phantom per-node accounting for a
+        foreign partition would double-count capacity nobody here owns."""
+        with self._lock:
+            ni = self._nodes.get(node_name)
+            return list(ni.pods) if ni is not None else []
+
     def known_node_names(self) -> List[str]:
         """Names of nodes the cache believes exist (entries kept only for
         straggler pods -- node=None -- are excluded: they are pod

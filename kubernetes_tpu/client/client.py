@@ -43,19 +43,21 @@ class Client:
         victim sets); missing pods are skipped."""
         return self._server.delete_bulk("Pod", keys)
 
-    def bind(self, binding: Binding) -> Pod:
-        """POST pods/<name>/binding (reference default_binder.go:50)."""
-        return self._server.bind(binding)
+    def bind(self, binding: Binding, binder: str = None) -> Pod:
+        """POST pods/<name>/binding (reference default_binder.go:50).
+        ``binder`` identifies the committing stack for the partitioned
+        control plane's server-side fence."""
+        return self._server.bind(binding, binder=binder)
 
-    def bind_bulk(self, bindings: List[Binding]):
+    def bind_bulk(self, bindings: List[Binding], binder: str = None):
         """One transaction committing a whole solver batch; returns a
         (pod, error) pair per binding."""
-        return self._server.bind_bulk(bindings)
+        return self._server.bind_bulk(bindings, binder=binder)
 
-    def bind_assumed_bulk(self, assumed_pods: List[Pod]):
+    def bind_assumed_bulk(self, assumed_pods: List[Pod], binder: str = None):
         """Allocation-free bulk bind from assumed clones; returns only
         the failed slots as (index, error)."""
-        return self._server.bind_assumed_bulk(assumed_pods)
+        return self._server.bind_assumed_bulk(assumed_pods, binder=binder)
 
     def update_pod_status(
         self, namespace: str, name: str, mutate: Callable[[Pod], None]

@@ -363,6 +363,9 @@ class InformerFactory:
     def csi_nodes(self) -> Informer:
         return self.informer("CSINode")
 
+    def priority_classes(self) -> Informer:
+        return self.informer("PriorityClass")
+
     def start(self) -> None:
         self._started = True
         for inf in list(self._informers.values()):

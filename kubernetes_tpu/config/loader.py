@@ -19,6 +19,7 @@ from kubernetes_tpu.config.types import (
     KubeSchedulerConfiguration,
     KubeSchedulerProfile,
     LeaderElectionConfiguration,
+    PartitionConfiguration,
     Plugin,
     PluginSet,
     Plugins,
@@ -136,6 +137,7 @@ def streaming_from_dict(st_raw: Dict[str, Any]) -> StreamingConfiguration:
             if "bandPriorityThreshold" in st_raw
             else None
         ),
+        band_priority_class=st_raw.get("bandPriorityClass", ""),
         max_queue_depth=int(st_raw.get("maxQueueDepth", 20000)),
         trace=st_raw.get("trace", "poisson"),
         rate_pods_per_sec=float(st_raw.get("rate", 1000.0)),
@@ -219,6 +221,23 @@ def load_config_from_dict(raw: Dict[str, Any]) -> KubeSchedulerConfiguration:
         commit_fencing=bool(rs_raw.get("commitFencing", True)),
     )
     cfg.streaming = streaming_from_dict(raw.get("streaming", {}))
+    pt_raw = raw.get("partition", {})
+    cfg.partition = PartitionConfiguration(
+        enabled=bool(pt_raw.get("enabled", False)),
+        num_partitions=int(pt_raw.get("numPartitions", 2)),
+        lease_duration_seconds=_duration_seconds(
+            pt_raw.get("leaseDuration", 1.0)
+        ),
+        retry_period_seconds=_duration_seconds(
+            pt_raw.get("retryPeriod", 0.1)
+        ),
+        clock_skew_tolerance_seconds=_duration_seconds(
+            pt_raw.get("clockSkewTolerance", 0.0)
+        ),
+        zone_aligned=bool(pt_raw.get("zoneAligned", False)),
+        resource_namespace=pt_raw.get("resourceNamespace", "kube-system"),
+        resource_prefix=pt_raw.get("resourcePrefix", "ksp-partition"),
+    )
     fi_raw = raw.get("faultInjection", {})
     cfg.fault_injection = FaultInjectionConfiguration(
         enabled=bool(fi_raw.get("enabled", False)),

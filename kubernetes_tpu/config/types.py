@@ -123,6 +123,28 @@ class ResilienceConfiguration:
 
 
 @dataclass
+class PartitionConfiguration:
+    """Multi-active partitioned scheduling (scheduler/partition.py): N
+    live scheduler stacks over one apiserver, each owning a consistent-
+    hash slice of the node space via per-partition Leases. Enabling
+    this replaces single-leader election for the stack (the stack runs
+    ACTIVE immediately, scoped to its held partitions)."""
+
+    enabled: bool = False
+    #: node-space slices; stacks split them by rendezvous hashing over
+    #: the live members, so it need not equal the stack count
+    num_partitions: int = 2
+    lease_duration_seconds: float = 1.0
+    retry_period_seconds: float = 0.1
+    clock_skew_tolerance_seconds: float = 0.0
+    #: partition by the node's zone label (LABEL_ZONE_KEYS) instead of
+    #: its name, so a zone fails over as one unit
+    zone_aligned: bool = False
+    resource_namespace: str = "kube-system"
+    resource_prefix: str = "ksp-partition"
+
+
+@dataclass
 class TPUSolverConfiguration:
     """The TPU batch-solver knobs (this build's extension of the wire
     config -- VERDICT r2 missing #8: solver_mode/mesh were
@@ -157,6 +179,10 @@ class StreamingConfiguration:
     # -- priority bands --------------------------------------------------
     #: pods with spec.priority >= this form the high band; None = off
     band_priority_threshold: Optional[int] = None
+    #: name of a PriorityClass object whose ``value`` selects the band
+    #: threshold (resolved live from the apiserver; overrides the raw
+    #: integer when both are set, and tracks PriorityClass updates)
+    band_priority_class: str = ""
     # -- backpressure ----------------------------------------------------
     #: activeQ depth that stalls the arrival engine; 0 = unbounded
     max_queue_depth: int = 20000
@@ -238,4 +264,7 @@ class KubeSchedulerConfiguration:
     )
     streaming: StreamingConfiguration = field(
         default_factory=StreamingConfiguration
+    )
+    partition: PartitionConfiguration = field(
+        default_factory=PartitionConfiguration
     )

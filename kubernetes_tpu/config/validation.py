@@ -148,6 +148,30 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
         if not 0.0 <= st.trough_fraction <= 1.0:
             errors.append("streaming.troughFraction must be in [0, 1]")
 
+    pt = getattr(cfg, "partition", None)
+    if pt is not None and pt.enabled:
+        if pt.num_partitions < 1:
+            errors.append("partition.numPartitions must be >= 1")
+        if pt.lease_duration_seconds <= 0:
+            errors.append("partition.leaseDuration must be positive")
+        if pt.retry_period_seconds <= 0:
+            errors.append("partition.retryPeriod must be positive")
+        if pt.retry_period_seconds >= pt.lease_duration_seconds:
+            errors.append(
+                "partition.retryPeriod must be < leaseDuration (a "
+                "holder must be able to renew before it expires)"
+            )
+        if pt.clock_skew_tolerance_seconds < 0:
+            errors.append("partition.clockSkewTolerance must be >= 0")
+        if not pt.resource_prefix:
+            errors.append("partition.resourcePrefix is required")
+        le = cfg.leader_election
+        if le.leader_elect:
+            errors.append(
+                "partition.enabled and leaderElection.leaderElect are "
+                "mutually exclusive (partitioned stacks are all active)"
+            )
+
     fi = getattr(cfg, "fault_injection", None)
     if fi is not None and fi.enabled:
         from kubernetes_tpu.robustness.faults import (

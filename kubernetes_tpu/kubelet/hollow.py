@@ -130,7 +130,17 @@ class HollowNodePool:
         server = self.client.server
         self._watch = server.watch("Pod", since_rv=0)
         while not self._stop.is_set():
-            evs = self._watch.next_batch(timeout=0.2)
+            try:
+                evs = self._watch.next_batch(timeout=0.2)
+            except Exception:  # noqa: BLE001 - lagged past the history
+                # trim (410 Gone): relist-and-diff like an informer --
+                # every bound pod still gets acked, never a dead thread
+                pods, rv = server.list("Pod")
+                self._watch = server.watch("Pod", since_rv=rv)
+                for pod in pods:
+                    if pod.spec.node_name:
+                        self._ack_pod(pod)
+                continue
             for ev in evs:
                 if ev.type in ("ADDED", "MODIFIED"):
                     pod = ev.object

@@ -48,6 +48,15 @@ def _info_key(pi: PodInfo) -> str:
     return _pod_key(pi.pod)
 
 
+def _band_priority(pod: Pod) -> int:
+    """The pod's effective priority for band selection: the admission
+    classifier stamps ``_band_priority`` once at ingest (resolving a
+    bare priorityClassName through the PriorityClass object); pods that
+    entered without classification fall back to the raw spec field."""
+    p = pod.__dict__.get("_band_priority")
+    return p if p is not None else pod.spec.priority
+
+
 class _NominatedPodMap:
     """Reference scheduling_queue.go:720."""
 
@@ -365,7 +374,7 @@ class PriorityQueue:
                         batch.extend(drained)
                         if band is not None:
                             has_high = has_high or any(
-                                pi.pod.spec.priority >= band
+                                _band_priority(pi.pod) >= band
                                 for pi in drained
                             )
                             self._observe_band_waits(drained, band, now)
@@ -404,7 +413,7 @@ class PriorityQueue:
         bulk = []
         for pi in drained:
             wait = max(0.0, now - pi.timestamp)
-            if pi.pod.spec.priority >= band:
+            if _band_priority(pi.pod) >= band:
                 high.append(wait)
             else:
                 bulk.append(wait)

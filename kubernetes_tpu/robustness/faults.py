@@ -275,6 +275,26 @@ def builtin_profiles() -> Dict[str, FaultProfile]:
                 FaultPoint.BIND_CONFLICT: PointConfig(rate=1.0, max_fires=1),
             },
         ),
+        # multi-active partition chaos (PR-8 acceptance shape): lease
+        # losses depose partition holders mid-burst (survivors must
+        # adopt the orphaned ranges), bind-conflict bursts force the
+        # committer's typed-conflict absorption, and transient API
+        # unavailability stresses the retry/relist seams -- all bounded
+        # so the run converges to 100% bound with a balanced conflict
+        # ledger
+        "partition-chaos": FaultProfile(
+            name="partition-chaos",
+            seed=0,
+            points={
+                FaultPoint.LEASE_RENEW_FAIL: PointConfig(
+                    rate=0.2, max_fires=12
+                ),
+                FaultPoint.BIND_CONFLICT: PointConfig(rate=1.0, max_fires=2),
+                FaultPoint.API_UNAVAILABLE: PointConfig(
+                    rate=0.03, max_fires=6
+                ),
+            },
+        ),
         # control-plane chaos (PR-2 acceptance shape): renew failures
         # that force a failover, transient API unavailability absorbed
         # by retries/relists, a truncated watch window (410 Gone), and a

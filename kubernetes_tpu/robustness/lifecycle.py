@@ -95,7 +95,18 @@ class PodRespawner:
         server = self.client.server
         self._watch = server.watch("Pod", since_rv=server.current_rv())
         while not self._stop.is_set():
-            for ev in self._watch.next_batch(timeout=0.2):
+            try:
+                evs = self._watch.next_batch(timeout=0.2)
+            except Exception:  # noqa: BLE001 - lagged past the history
+                # trim (410 Gone): reopen from now. Deletes that landed
+                # in the gap are missed respawns -- degraded, never a
+                # dead thread.
+                logger.warning("respawner watch lagged; reopening")
+                self._watch = server.watch(
+                    "Pod", since_rv=server.current_rv()
+                )
+                continue
+            for ev in evs:
                 if ev.type != "DELETED":
                     continue
                 pod = ev.object

@@ -145,6 +145,7 @@ def classify_pod(
     listers,
     volume_gen: int,
     token: object,
+    priority_resolver=None,
 ) -> Admission:
     """Build (and memoize on the pod) the full admission record. Safe to
     call from informer threads: lister reads take the informers' own
@@ -202,6 +203,17 @@ def classify_pod(
             _preferred_aff_terms(pod) or _preferred_anti_terms(pod)
         )
         adm.scoring_terms = adm.score_pref or bool(_required_aff_terms(pod))
+
+    # effective priority for the streaming band (stamped ONCE at ingest
+    # next to the admission memo): pods that carry only a
+    # priorityClassName get the PriorityClass object's value resolved
+    # here, so the queue's band check stays a memo read -- PriorityClass
+    # OBJECTS, not raw integers, select the band
+    if priority_resolver is not None:
+        try:
+            pod.__dict__["_band_priority"] = int(priority_resolver(pod))
+        except Exception:  # noqa: BLE001 - band is advisory, never block
+            pod.__dict__.pop("_band_priority", None)
 
     pod.__dict__["_admission"] = adm
     return adm

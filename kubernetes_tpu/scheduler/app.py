@@ -101,6 +101,14 @@ class SchedulerApp:
                 self.config.robustness
             ),
         )
+        from kubernetes_tpu.scheduler.scheduler import (
+            apply_streaming_config,
+        )
+
+        apply_streaming_config(
+            self.sched, self.config, self.informers, batch=batch,
+            max_batch=getattr(self.sched, "max_batch", 256),
+        )
         injector = injector_from_configuration(self.config.fault_injection)
         if injector is not None:
             install_injector(injector)
@@ -112,6 +120,22 @@ class SchedulerApp:
             snapshot=self.sched.algorithm.snapshot,
         )
         self.elector: Optional[LeaderElector] = None
+        self.coordinator = None
+        if getattr(self.config, "partition", None) is not None and (
+            self.config.partition.enabled
+        ):
+            # multi-active partitioned mode: this stack runs ACTIVE
+            # immediately, scoped to the node-space partitions its
+            # coordinator holds (scheduler/partition.py); leader
+            # election is not used (validation rejects combining them)
+            from kubernetes_tpu.scheduler.partition import (
+                attach_partitioning,
+            )
+
+            self.coordinator = attach_partitioning(
+                self.sched, self.client, self.config.partition,
+                self.identity,
+            )
         self.reconciler: Optional[ControlPlaneReconciler] = None
         self.recovery_report = None
         self._http: Optional[ThreadingHTTPServer] = None
@@ -132,6 +156,11 @@ class SchedulerApp:
     # -- run (server.go:164) -------------------------------------------------
 
     def start(self) -> None:
+        if self.coordinator is not None:
+            # claim partitions BEFORE the informers sync so the event
+            # handlers filter the very first frames against a live
+            # ownership set (start() runs one synchronous claim round)
+            self.coordinator.start()
         self.informers.start()
         self.informers.wait_for_cache_sync()
         # Crash recovery (scheduler/resilience.py): the relist above
@@ -152,7 +181,9 @@ class SchedulerApp:
                 drift_interval=rs.drift_check_interval_seconds,
             )
             self.reconciler.start()
-        if self.config.leader_election.leader_elect:
+        if self.coordinator is not None:
+            self.sched.start()
+        elif self.config.leader_election.leader_elect:
             self.elector = LeaderElector(
                 self.client,
                 self.config.leader_election,
@@ -173,6 +204,13 @@ class SchedulerApp:
     def stop(self) -> None:
         if self.reconciler is not None:
             self.reconciler.stop()
+        if self.coordinator is not None:
+            # graceful: release the partition leases so siblings adopt
+            # immediately instead of waiting out the lease duration.
+            # A SIMULATED crash (sched.crashed) abandons them instead --
+            # a dead process can't release, and the takeover path is
+            # exactly what the chaos harness is measuring.
+            self.coordinator.stop(release=not self.sched.crashed)
         if self.elector is not None:
             self.elector.stop()
             self.elector.release()

@@ -26,7 +26,26 @@ def _assigned(pod: Pod) -> bool:
 
 
 def _responsible_for_pod(sched: "Scheduler", pod: Pod) -> bool:
-    return pod.spec.scheduler_name in sched.profiles
+    """Queue-side responsibility: the pod names one of our profiles
+    AND, in a partitioned stack, its home partition (uid hash, or the
+    spill re-stamp) is held here -- each pending pod has exactly ONE
+    home stack, so N active stacks never race over fresh work. Read
+    dynamically: ownership changes at takeover/handoff."""
+    if pod.spec.scheduler_name not in sched.profiles:
+        return False
+    coord = sched.partition_coordinator
+    return coord is None or coord.wants_pod(pod)
+
+
+def _cache_side(sched: "Scheduler", pod: Pod) -> bool:
+    """Cache-side responsibility: bound, and bound to a node whose
+    partition this stack holds (a partitioned cache carries ONLY its
+    slice of the node space -- that division is the scale-out: each
+    stack's tensors are N/P rows)."""
+    if not pod.spec.node_name:
+        return False
+    coord = sched.partition_coordinator
+    return coord is None or coord.owns_node(pod.spec.node_name)
 
 
 def add_all_event_handlers(
@@ -133,18 +152,23 @@ def add_all_event_handlers(
 
     def combined_pod_update(old, new) -> None:
         """Per-event fallback (non-batch dispatch): both sides' filter-
-        transition semantics (FilteringResourceEventHandler)."""
-        new_a = _assigned(new)
-        old_a = old is not None and _assigned(old)
+        transition semantics (FilteringResourceEventHandler). Cache
+        membership follows ``_cache_side`` (bound AND on an owned node);
+        queue membership still excludes ANY bound pod -- a pod bound
+        into a foreign partition is simply not ours on either side."""
+        new_a = _cache_side(sched, new)
+        old_a = old is not None and _cache_side(sched, old)
         if old_a and new_a:
             update_pod_in_cache(old, new)
         elif not old_a and new_a:
             add_pod_to_cache(new)
         elif old_a and not new_a:
             delete_pod_from_cache(old)
-        new_q = not new_a and _responsible_for_pod(sched, new)
+        new_q = not _assigned(new) and _responsible_for_pod(sched, new)
         old_q = (
-            old is not None and not old_a and _responsible_for_pod(sched, old)
+            old is not None
+            and not _assigned(old)
+            and _responsible_for_pod(sched, old)
         )
         if old_q and new_q:
             update_pod_in_queue(old, new)
@@ -155,13 +179,15 @@ def add_all_event_handlers(
 
     def combined_pod_add(pod) -> None:
         if _assigned(pod):
-            add_pod_to_cache(pod)
+            if _cache_side(sched, pod):
+                add_pod_to_cache(pod)
         elif _responsible_for_pod(sched, pod):
             add_pod_to_queue(pod)
 
     def combined_pod_delete(pod) -> None:
         if _assigned(pod):
-            delete_pod_from_cache(pod)
+            if _cache_side(sched, pod):
+                delete_pod_from_cache(pod)
         elif _responsible_for_pod(sched, pod):
             delete_pod_from_queue(pod)
 
@@ -181,9 +207,14 @@ def add_all_event_handlers(
         queue_runs = []  # ("adds"|"dels", [pods]) | per-event kinds
 
         for etype, old, new in frame:
-            new_a = bool(new.spec.node_name)
+            # cache membership = bound AND (partitioned) on an owned
+            # node; queue membership still excludes ANY bound pod, and
+            # queue LEAVES stay keyed on the profile alone (deleting an
+            # absent key is free; skipping a stale one is not)
+            new_a = _cache_side(sched, new)
+            new_bound = bool(new.spec.node_name)
             if etype == "MODIFIED":
-                old_a = old is not None and bool(old.spec.node_name)
+                old_a = old is not None and _cache_side(sched, old)
                 if new_a:
                     if old_a:
                         cache_runs.append(("update", (old, new)))
@@ -193,8 +224,8 @@ def add_all_event_handlers(
                             cache_runs[-1][1].append(new)
                         else:
                             cache_runs.append(("adds", [new]))
-                        if old is not None and _responsible_for_pod(
-                            sched, old
+                        if old is not None and (
+                            old.spec.scheduler_name in profiles
                         ):
                             if queue_runs and queue_runs[-1][0] == "dels":
                                 queue_runs[-1][1].append(old)
@@ -205,8 +236,19 @@ def add_all_event_handlers(
                         cache_runs[-1][1].append(old)
                     else:
                         cache_runs.append(("dels", [old]))
-                    if _responsible_for_pod(sched, new):
+                    if not new_bound and _responsible_for_pod(sched, new):
                         queue_runs.append(("add_one", new))
+                elif new_bound:
+                    # bound into a foreign partition: not ours on either
+                    # side, but a pod WE queued must still leave the
+                    # queue (the sibling stack won it)
+                    if old is not None and (
+                        old.spec.scheduler_name in profiles
+                    ):
+                        if queue_runs and queue_runs[-1][0] == "dels":
+                            queue_runs[-1][1].append(old)
+                        else:
+                            queue_runs.append(("dels", [old]))
                 else:
                     old_q = old is not None and _responsible_for_pod(
                         sched, old
@@ -216,7 +258,15 @@ def add_all_event_handlers(
                         queue_runs.append(("update", (old, new)))
                     elif not old_q and new_q:
                         queue_runs.append(("add_one", new))
-                    elif old_q:
+                    elif old_q or (
+                        old is not None
+                        and old.spec.scheduler_name in profiles
+                        and not new_q
+                    ):
+                        # covers the partition handoff: a pod whose home
+                        # partition moved (spill re-stamp) leaves this
+                        # stack's queue even though neither snapshot is
+                        # "responsible" under current ownership
                         if queue_runs and queue_runs[-1][0] == "dels":
                             queue_runs[-1][1].append(old)
                         else:
@@ -227,7 +277,7 @@ def add_all_event_handlers(
                         cache_runs[-1][1].append(new)
                     else:
                         cache_runs.append(("adds", [new]))
-                elif _responsible_for_pod(sched, new):
+                elif not new_bound and _responsible_for_pod(sched, new):
                     if new.metadata.labels.get(POD_GROUP_LABEL):
                         # gang sibling wakeups take the per-event path
                         queue_runs.append(("add_one", new))
@@ -241,7 +291,9 @@ def add_all_event_handlers(
                         cache_runs[-1][1].append(new)
                     else:
                         cache_runs.append(("dels", [new]))
-                elif _responsible_for_pod(sched, new):
+                elif not new_bound and (
+                    new.spec.scheduler_name in profiles
+                ):
                     queue_runs.append(("del_one", new))
 
         # cache phase (whole frame), then queue phase
@@ -297,18 +349,32 @@ def add_all_event_handlers(
         )
     )
 
-    # nodes -> cache + queue wakeups (:406)
+    # nodes -> cache + queue wakeups (:406). A partitioned stack's cache
+    # carries ONLY its slice of the node space (owns_node_obj also
+    # teaches the coordinator zone->partition mappings in zone-aligned
+    # mode); partition acquire/release syncs membership out of band.
+    def _node_ours(node: Node) -> bool:
+        coord = sched.partition_coordinator
+        return coord is None or coord.owns_node_obj(node)
+
     def add_node(node: Node) -> None:
+        if not _node_ours(node):
+            return
         sched.cache.add_node(node)
         sched.queue.move_all_to_active_or_backoff_queue(events.NodeAdd)
 
     def update_node(old: Node, new: Node) -> None:
+        if not _node_ours(new):
+            return
         sched.cache.update_node(old, new)
         event = _node_scheduling_properties_changed(old, new)
         if event:
             sched.queue.move_all_to_active_or_backoff_queue(event)
 
     def delete_node(node: Node) -> None:
+        coord = sched.partition_coordinator
+        if coord is not None and not coord.owns_node(node.metadata.name):
+            return
         sched.cache.remove_node(node)
 
     nodes.add_event_handler(

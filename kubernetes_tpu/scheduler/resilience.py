@@ -78,8 +78,15 @@ def recover_on_startup(sched: "Scheduler", client: "Client") -> RecoveryReport:
         # recovery just goes unmetered for this incarnation
         logger.exception("startup recovery list failed; skipping")
         return report
+    # partitioned stack: recovery is scoped to the held partitions --
+    # a sibling's pods and nodes are its incarnation's job
+    coord = getattr(sched, "partition_coordinator", None)
     for pod in pods:
         if pod.spec.node_name:
+            if coord is not None and not coord.owns_node(
+                pod.spec.node_name
+            ):
+                continue
             report.adopted += 1
             if sched.cache.get_pod(pod) is None:
                 # informer sync missed it (watch raced the relist): adopt
@@ -92,6 +99,7 @@ def recover_on_startup(sched: "Scheduler", client: "Client") -> RecoveryReport:
         elif (
             pod.spec.scheduler_name in sched.profiles
             and pod.metadata.deletion_timestamp is None
+            and (coord is None or coord.wants_pod(pod))
         ):
             # pending: either genuinely new or a predecessor's
             # assumed-but-never-bound in-flight pod -- both are pending
@@ -217,6 +225,22 @@ class ControlPlaneReconciler:
         except Exception:
             logger.exception("drift check list failed; will retry")
             return report
+        # partitioned stack: the cache legitimately excludes foreign
+        # partitions, so the drift sweep only compares the owned slice
+        # (healing a sibling's nodes in would phantom-double capacity)
+        coord = getattr(self.sched, "partition_coordinator", None)
+        if coord is not None:
+            nodes = [
+                n for n in nodes if coord.owns_node_obj(n)
+            ]
+            pods = [
+                p for p in pods
+                if (
+                    coord.owns_node(p.spec.node_name)
+                    if p.spec.node_name
+                    else coord.wants_pod(p)
+                )
+            ]
         cached = cache.pod_states_snapshot()
         api_bound: Dict[str, object] = {
             p.metadata.uid: p for p in pods if p.spec.node_name
@@ -281,6 +305,7 @@ class ControlPlaneReconciler:
                 and live.metadata.uid == uid
                 and live.spec.scheduler_name in self.sched.profiles
                 and live.metadata.deletion_timestamp is None
+                and (coord is None or coord.wants_pod(live))
             ):
                 # the pod still wants scheduling (cache wrongly believed
                 # it placed): give it back to the queue

@@ -85,6 +85,22 @@ class Scheduler:
         # set when an injected crash_between_assume_and_bind fired: the
         # process is "dead" -- the loop halts and NO cleanup runs
         self.crashed = False
+        # multi-active partitioned scheduling (scheduler/partition.py):
+        # when a coordinator is attached this stack owns a node-space
+        # slice; event handlers, recovery sweeps, pop-time skips, and
+        # commit fencing all consult it
+        self.partition_coordinator = None
+        # the conflict ledger: every typed bind conflict the committer
+        # absorbs lands in exactly one disposition bucket --
+        # requeued-for-retry or satisfied-elsewhere (the pod turned out
+        # bound already). The tier-1 guard pins
+        # absorbed == requeues + stale, so no conflict is silently lost.
+        self.bind_conflicts_absorbed = 0
+        self.conflict_requeues = 0
+        self.conflict_stale_binds = 0
+        # pods re-stamped and forwarded to a sibling partition because
+        # their feasible nodes all live there
+        self.pods_spilled = 0
 
     # -- profile lookup (scheduler.go:741 profileForPod) --------------------
 
@@ -109,6 +125,12 @@ class Scheduler:
         if self.cache.is_assumed_pod(pod):
             return True
         if self.cache.has_pod_uid(pod.metadata.uid):
+            return True
+        coord = self.partition_coordinator
+        if coord is not None and not coord.wants_pod(pod):
+            # partitioned: the pod's home partition moved (spill
+            # re-stamp, partition handoff) while it sat in our queue --
+            # its new home stack schedules it
             return True
         return False
 
@@ -197,6 +219,16 @@ class Scheduler:
             return Status.error(
                 "lease lost before bind; commit fenced"
             )
+        coord = self.partition_coordinator
+        if coord is not None and not coord.may_bind(host):
+            # partitioned commit fence on the per-pod path (Permit
+            # waiters, custom binds): same fresh-probe rule as the bulk
+            # committer; the binding cycle's failure path guarantees
+            # forget + Unreserve + requeue
+            metrics.fencing_aborts.inc()
+            return Status.error(
+                f"partition of node {host} not held at bind; fenced"
+            )
         for extender in self.algorithm.extenders:
             if extender.is_binder() and extender.is_interested(assumed):
                 try:
@@ -259,8 +291,15 @@ class Scheduler:
         pod_scheduling_cycle: int,
     ) -> None:
         """FitError branch of scheduleOne (scheduler.go:581-591):
-        try preemption, then record the failure + nomination."""
+        try preemption, then record the failure + nomination. In a
+        partitioned stack, a pod that cannot place on OUR nodes spills
+        to a sibling partition first -- its feasible nodes may simply
+        live elsewhere; preemption and backoff apply only once every
+        partition has had a look."""
         pod = pod_info.pod
+        coord = self.partition_coordinator
+        if coord is not None and coord.try_spill(pod):
+            return
         nominated_node = ""
         if self.preemptor is not None:
             try:
@@ -744,31 +783,88 @@ def new_scheduler_from_config(
     )
     if ts.enabled:
         sched.batch_window = ts.batch_window_seconds
-    st = getattr(cfg, "streaming", None)
-    if st is not None and st.enabled:
-        # open-loop streaming: the priority-band threshold arms queue
-        # jumping on ANY scheduler (the band lives in the queue), and
-        # the SLO-adaptive controller replaces the static batchWindow/
-        # maxBatch behavior on the batch path (streaming/autobatch.py)
-        if st.band_priority_threshold is not None:
-            sched.queue.band_threshold = st.band_priority_threshold
-        if ts.enabled:
-            from kubernetes_tpu.streaming.autobatch import (
-                AutoBatchController,
-            )
-
-            sched.attach_autobatch(AutoBatchController(
-                slo_p99_seconds=st.slo_p99_seconds,
-                min_window=st.min_window_seconds,
-                max_window=st.max_window_seconds,
-                latency_batch=st.latency_batch,
-                max_batch=ts.max_batch,
-                interval_seconds=st.controller_interval_seconds,
-            ))
+    apply_streaming_config(
+        sched, cfg, informer_factory, batch=ts.enabled,
+        max_batch=ts.max_batch,
+    )
     injector = injector_from_configuration(cfg.fault_injection)
     if injector is not None:
         install_injector(injector)
     return sched
+
+
+def apply_streaming_config(
+    sched: Scheduler,
+    cfg,
+    informer_factory: InformerFactory,
+    *,
+    batch: bool,
+    max_batch: int,
+) -> None:
+    """Wire the ``streaming:`` block onto a built scheduler -- shared
+    by ``new_scheduler_from_config`` and ``SchedulerApp`` (which builds
+    through ``new_scheduler`` directly): the priority-band threshold
+    arms queue jumping on ANY scheduler (the band lives in the queue),
+    and the SLO-adaptive controller replaces the static batchWindow/
+    maxBatch behavior on the batch path (streaming/autobatch.py)."""
+    st = getattr(cfg, "streaming", None)
+    if st is None or not st.enabled:
+        return
+    if st.band_priority_threshold is not None:
+        sched.queue.band_threshold = st.band_priority_threshold
+    if getattr(st, "band_priority_class", ""):
+        # PriorityClass OBJECTS -- not raw integers -- select the
+        # band: the named class's value arms the threshold, and a
+        # PriorityClass update re-arms it live (the admission
+        # classifier stamps each pod's resolved priority at ingest,
+        # so the queue compares memo reads against this value)
+        _wire_band_priority_class(
+            sched, informer_factory, st.band_priority_class,
+            fallback=st.band_priority_threshold,
+        )
+    if batch:
+        from kubernetes_tpu.streaming.autobatch import (
+            AutoBatchController,
+        )
+
+        sched.attach_autobatch(AutoBatchController(
+            slo_p99_seconds=st.slo_p99_seconds,
+            min_window=st.min_window_seconds,
+            max_window=st.max_window_seconds,
+            latency_batch=st.latency_batch,
+            max_batch=max_batch,
+            interval_seconds=st.controller_interval_seconds,
+        ))
+
+
+def _wire_band_priority_class(
+    sched: Scheduler,
+    informer_factory: InformerFactory,
+    class_name: str,
+    fallback: Optional[int] = None,
+) -> None:
+    """Arm (and live-track) the streaming band threshold from a named
+    PriorityClass object: add/update events for that class set
+    ``queue.band_threshold`` to its value; deleting it reverts to the
+    configured raw ``bandPriorityThreshold`` integer (None when unset:
+    band off). Registered before factory start so the initial list
+    replay arms the threshold at sync."""
+    from kubernetes_tpu.client.informer import ResourceEventHandler
+
+    def _apply(*args) -> None:
+        obj = args[-1]
+        if obj.metadata.name == class_name:
+            sched.queue.band_threshold = int(obj.value)
+
+    def _disarm(obj) -> None:
+        if obj.metadata.name == class_name:
+            sched.queue.band_threshold = fallback
+
+    informer_factory.priority_classes().add_event_handler(
+        ResourceEventHandler(
+            on_add=_apply, on_update=_apply, on_delete=_disarm
+        )
+    )
 
 
 def _prune_unregistered(plugins: Plugins, registry: Registry) -> Plugins:

@@ -33,6 +33,23 @@ Control law (deliberately simple, deterministic, and hysteretic):
   controller converges and stops moving (the tier-1 oscillation guard
   pins this).
 
+Overload is special-cased (ROADMAP item-2 residual b): at sustained
+overload the RAW pressure signal whipsaws -- a deep queue pins the
+sojourn estimate, the resulting max-batch drain empties the window's
+view of the queue, the estimate collapses, the controller shrinks, the
+backlog re-forms, it grows again (~10 window moves inside a failing
+rung measured on this box). Two mechanisms calm it:
+
+- the decision signal is an **EWMA** of the pressure ratio
+  (``pressure_ewma_alpha``), so one big drain can't fake a recovery;
+- crossing the grow threshold ``latch_after_steps`` consecutive times
+  **latches throughput mode**: shrinks are blocked until the smoothed
+  pressure stays under the shrink threshold for
+  ``unlatch_after_steps`` consecutive decisions. A latched controller
+  parked at the throughput pole makes at most the initial grow moves
+  on a sustained overload series (unit-pinned at <= 2).
+
+
 ``step()`` is a pure function of its arguments plus controller state:
 a fixed input sequence always produces the same window/cap trajectory
 (deterministic-trace convergence tests). ``maybe_step()`` is the
@@ -67,6 +84,9 @@ class AutoBatchController:
         shrink_fraction: float = 0.15,
         grow_floor_window: float = 0.02,
         idle_grow_guard: float = 0.5,
+        pressure_ewma_alpha: float = 0.4,
+        latch_after_steps: int = 2,
+        unlatch_after_steps: int = 4,
         now=time.monotonic,
     ) -> None:
         if slo_p99_seconds <= 0:
@@ -109,6 +129,16 @@ class AutoBatchController:
         self._last_pop_wait = 0.0
         self._last_step_t: Optional[float] = None
 
+        # -- overload latch state (EWMA-smoothed pressure) ----------------
+        self.pressure_ewma_alpha = min(1.0, max(0.0, pressure_ewma_alpha))
+        self.latch_after_steps = max(1, int(latch_after_steps))
+        self.unlatch_after_steps = max(1, int(unlatch_after_steps))
+        self.pressure_ewma = 0.0
+        self.latched = False
+        self.latches = 0  # times the latch engaged (visibility)
+        self._over_streak = 0
+        self._calm_streak = 0
+
     # -- the control law ----------------------------------------------------
 
     def step(
@@ -149,13 +179,53 @@ class AutoBatchController:
             # saturation (estimate pins to the SLO, forcing a grow); an
             # empty queue with no drain is plain idle
             wait_est = self.slo if depth > 0 else 0.0
-        pressure = wait_est / self.slo
+        raw_pressure = wait_est / self.slo
+        # the DECISION signal is the smoothed pressure: one max-batch
+        # drain that momentarily empties the queue can no longer fake a
+        # recovery mid-overload (the pole-hunting residual)
+        a = self.pressure_ewma_alpha
+        self.pressure_ewma = a * raw_pressure + (1.0 - a) * self.pressure_ewma
+        pressure = self.pressure_ewma
+
+        # latch bookkeeping: consecutive over-threshold decisions engage
+        # it; consecutive calm decisions release it
+        if pressure > self.grow_fraction and (
+            idle_frac < self.idle_grow_guard
+        ):
+            # the idle-dispatcher guard applies to the latch too: depth
+            # piling up while the dispatcher is blocked on arrivals is
+            # not overload, and must neither grow nor latch
+            self._over_streak += 1
+            self._calm_streak = 0
+            if (
+                not self.latched
+                and self._over_streak >= self.latch_after_steps
+            ):
+                self.latched = True
+                self.latches += 1
+                metrics.autobatch_latched.set(1.0)
+                # sustained overload: walking the window up one
+                # doubling per interval just prolongs the failing rung.
+                # Jump straight to the throughput pole and hold there.
+                return self._apply(
+                    "grow", (self.max_window, self.max_batch)
+                )
+        elif pressure < self.shrink_fraction:
+            self._calm_streak += 1
+            self._over_streak = 0
+            if self.latched and self._calm_streak >= self.unlatch_after_steps:
+                self.latched = False
+                metrics.autobatch_latched.set(0.0)
+        else:
+            self._over_streak = 0
+            self._calm_streak = 0
 
         if pressure > self.grow_fraction and idle_frac < self.idle_grow_guard:
             return self._apply("grow", self._grown())
         if (
             pressure < self.shrink_fraction
             and depth <= self.latency_batch
+            and not self.latched
         ):
             return self._apply("shrink", self._shrunk())
         return "hold"

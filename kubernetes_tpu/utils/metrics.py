@@ -378,6 +378,12 @@ autobatch_batch_cap = registry.register(Gauge(
     "Current adaptive dispatch cap (pods per pop_batch drain; also "
     "floors the padded solve shape).",
 ))
+autobatch_latched = registry.register(Gauge(
+    "scheduler_autobatch_overload_latched",
+    "1 while the controller's overload latch holds throughput mode "
+    "(EWMA pressure crossed the grow threshold repeatedly; shrinks "
+    "blocked until it calms).",
+))
 queue_band_wait = registry.register(Histogram(
     "scheduler_queue_band_wait_seconds",
     "ActiveQ wait (enqueue to drain) by priority band; only recorded "
@@ -393,6 +399,39 @@ backpressure_stall_seconds = registry.register(Counter(
     "scheduler_arrival_backpressure_stall_seconds_total",
     "Cumulative wall clock the arrival engine spent stalled on the "
     "activeQ depth gate.",
+))
+# multi-active partitioned scheduling (scheduler/partition.py): N live
+# stacks over one apiserver -- conflicts, spills, and takeovers are the
+# rehearsed coordination paths and every one must be accounted (the
+# conflict ledger: absorbed == requeued + satisfied, no silent loss)
+bind_conflicts_absorbed = registry.register(Counter(
+    "scheduler_bind_conflicts_absorbed_total",
+    "Typed bind conflicts (already-bound / uid-mismatch / foreign-"
+    "partition / partition-fence) absorbed by the committer through "
+    "the requeue path instead of surfacing as scheduler errors, by "
+    "conflict kind.",
+    ("kind",),
+))
+pods_spilled = registry.register(Counter(
+    "scheduler_pods_spilled_total",
+    "Pods re-stamped to a sibling partition and forwarded through the "
+    "apiserver because their feasible nodes live in a foreign "
+    "partition.",
+))
+partition_takeovers = registry.register(Counter(
+    "scheduler_partition_takeovers_total",
+    "Foreign partitions seized after their holder's lease lapsed "
+    "(stack crash, injected renew failures).",
+))
+partition_takeover_ms = registry.register(Histogram(
+    "scheduler_partition_takeover_ms",
+    "Lapsed-partition takeover latency: expiry detection to adoption "
+    "complete (nodes in cache, orphaned pods requeued), milliseconds.",
+    buckets=(5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000),
+))
+partitions_held = registry.register(Gauge(
+    "scheduler_partitions_held",
+    "Partitions currently held by this stack's coordinator.",
 ))
 
 

@@ -1,0 +1,478 @@
+"""Units for the partition ownership layer (scheduler/partition.py):
+consistent-hash partitioning, balanced rendezvous assignment, lease
+claim/renew/fencing, spill re-stamping, and the apiserver's typed
+bind-conflict surface (BindConflict + PartitionAuthority)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import Lease, ObjectMeta
+from kubernetes_tpu.apiserver.server import (
+    APIServer,
+    BindConflict,
+    Conflict,
+    Gone,
+)
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.config.types import PartitionConfiguration
+from kubernetes_tpu.robustness.faults import (
+    FaultInjector,
+    FaultPoint,
+    FaultProfile,
+    PointConfig,
+    install_injector,
+)
+from kubernetes_tpu.scheduler.partition import (
+    PartitionAuthority,
+    PartitionCoordinator,
+    SPILL_COUNT_ANNOTATION,
+    SPILL_TARGET_ANNOTATION,
+    compute_assignment,
+    partition_of_name,
+)
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    yield
+    install_injector(None)
+
+
+class TestPartitionHash:
+    def test_stable_and_in_range(self):
+        for p in (1, 2, 3, 7):
+            for name in ("n1", "node-42", "zone-a", ""):
+                k = partition_of_name(name, p)
+                assert 0 <= k < p
+                assert k == partition_of_name(name, p)  # stable
+
+    def test_single_partition_is_zero(self):
+        assert partition_of_name("anything", 1) == 0
+        assert partition_of_name("anything", 0) == 0
+
+    def test_spreads(self):
+        ks = {partition_of_name(f"node-{i}", 4) for i in range(100)}
+        assert ks == {0, 1, 2, 3}
+
+
+class TestAssignment:
+    def test_covers_every_partition(self):
+        a = compute_assignment(8, ["a", "b", "c"])
+        assert sorted(a) == list(range(8))
+
+    def test_balanced_cap(self):
+        for p, m in ((2, 2), (4, 2), (8, 3), (5, 5)):
+            members = [f"s{i}" for i in range(m)]
+            a = compute_assignment(p, members)
+            counts = {mem: 0 for mem in members}
+            for owner in a.values():
+                counts[owner] += 1
+            cap = -(-p // m)
+            assert max(counts.values()) <= cap
+            # with P >= M every member gets work
+            if p >= m:
+                assert min(counts.values()) >= 1
+
+    def test_deterministic_and_order_independent(self):
+        a1 = compute_assignment(6, ["x", "y", "z"])
+        a2 = compute_assignment(6, ["z", "x", "y"])
+        assert a1 == a2
+
+    def test_dead_member_partitions_split_with_bounded_collateral(self):
+        before = compute_assignment(8, ["a", "b", "c", "d"])
+        after = compute_assignment(8, ["a", "b", "c"])
+        orphans = {k for k, o in before.items() if o == "d"}
+        moved = {k for k in range(8) if before[k] != after.get(k)}
+        # every orphan lands on a survivor
+        assert orphans <= moved
+        for k in orphans:
+            assert after[k] in ("a", "b", "c")
+        # movement beyond the orphans is the balance-cap rebalance only:
+        # bounded by the member count, NOT proportional to P (the
+        # "split the orphaned range without reshuffling the world"
+        # property a full rehash would violate)
+        assert len(moved - orphans) <= 3, (before, after)
+        # and the survivors stay balanced under the new cap
+        counts = {m: 0 for m in ("a", "b", "c")}
+        for owner in after.values():
+            counts[owner] += 1
+        assert max(counts.values()) <= 3
+
+
+def _config(**kw):
+    defaults = dict(
+        enabled=True, num_partitions=2,
+        lease_duration_seconds=0.5, retry_period_seconds=0.05,
+    )
+    defaults.update(kw)
+    return PartitionConfiguration(**defaults)
+
+
+class _FakeSched:
+    """The minimal scheduler surface the coordinator touches outside
+    adoption (spill bookkeeping + crash flag)."""
+
+    def __init__(self):
+        self.pods_spilled = 0
+        self.crashed = False
+        self.profiles = {}
+
+
+class TestCoordinatorLeases:
+    def test_claims_all_when_alone(self):
+        server = APIServer()
+        c = PartitionCoordinator(
+            Client(server), _FakeSched(), _config(num_partitions=3), "s1"
+        )
+        # no adoption machinery on the fake sched: short-circuit it
+        c._adopt_partition = lambda k: None
+        c.step()
+        assert sorted(c.held) == [0, 1, 2]
+        assert all(c.holds_partition(k) for k in (0, 1, 2))
+        assert c.may_bind("node-x")
+
+    def test_two_coordinators_split_and_fence(self):
+        server = APIServer()
+        cfgs = _config(num_partitions=4)
+        cs = []
+        for ident in ("s1", "s2"):
+            c = PartitionCoordinator(
+                Client(server), _FakeSched(), cfgs, ident
+            )
+            c._adopt_partition = lambda k: None
+            c._drop_partition = lambda k: None
+            cs.append(c)
+        # a few alternating rounds converge to a 2/2 split
+        for _ in range(6):
+            for c in cs:
+                c.step()
+        held = [sorted(c.held) for c in cs]
+        assert len(held[0]) == 2 and len(held[1]) == 2
+        assert sorted(held[0] + held[1]) == [0, 1, 2, 3]
+        # fencing: each holds exactly its own partitions
+        for c, other in (cs, reversed(cs)):
+            for k in c.held:
+                assert c.holds_partition(k)
+                assert not other.holds_partition(k)
+
+    def test_renew_failure_drops_held_locally_and_sibling_adopts(self):
+        server = APIServer()
+        cfgs = _config(num_partitions=2)
+        cs = []
+        for ident in ("s1", "s2"):
+            c = PartitionCoordinator(
+                Client(server), _FakeSched(), cfgs, ident
+            )
+            c._adopt_partition = lambda k: None
+            c._drop_partition = lambda k: None
+            cs.append(c)
+        for _ in range(4):
+            for c in cs:
+                c.step()
+        assert len(cs[0].held) == 1 and len(cs[1].held) == 1
+        victim, survivor = cs
+        victim.fault_injector = FaultInjector(FaultProfile(
+            "kill", seed=0,
+            points={FaultPoint.LEASE_RENEW_FAIL: PointConfig(rate=1.0)},
+        ))
+        deadline = time.time() + 10
+        while time.time() < deadline and (
+            len(survivor.held) < 2 or victim.held
+        ):
+            victim.step()
+            survivor.step()
+            time.sleep(0.05)
+        assert sorted(survivor.held) == [0, 1], "survivor never adopted"
+        assert not victim.held, "deposed stack never dropped locally"
+        assert survivor.takeovers >= 1
+
+    def test_fence_hosts_probes_per_partition(self):
+        server = APIServer()
+        c = PartitionCoordinator(
+            Client(server), _FakeSched(), _config(num_partitions=2), "s1"
+        )
+        c._adopt_partition = lambda k: None
+        c.step()
+        hosts = [f"n{i}" for i in range(6)]
+        assert c.fence_hosts(hosts) == set()
+        # seize one partition lease out from under it
+        k = c.node_partition(hosts[0])
+
+        def mutate(obj: Lease) -> None:
+            obj.holder_identity = "intruder"
+            obj.renew_time = time.monotonic()
+            obj.lease_duration_seconds = 30.0
+
+        server.guaranteed_update(
+            "Lease", "kube-system", f"ksp-partition-{k}", mutate
+        )
+        fenced = c.fence_hosts(hosts)
+        assert fenced == {
+            i for i, h in enumerate(hosts) if c.node_partition(h) == k
+        }
+
+
+class TestSpill:
+    def _pod_on_server(self, server, name="sp-1"):
+        client = Client(server)
+        pod = make_pod(name).container(cpu="100m", memory="128Mi").obj()
+        client.create_pod(pod)
+        return client, pod
+
+    def test_spill_stamps_target_and_count(self):
+        server = APIServer()
+        client, pod = self._pod_on_server(server)
+        sched = _FakeSched()
+        c = PartitionCoordinator(
+            client, sched, _config(num_partitions=3), "s1"
+        )
+        home = c.pod_partition(pod)
+        c.held = {home: 1}
+        assert c.try_spill(pod)
+        live = client.get_pod("default", pod.metadata.name)
+        target = int(live.metadata.annotations[SPILL_TARGET_ANNOTATION])
+        assert target != home and target not in c.held
+        assert live.metadata.annotations[SPILL_COUNT_ANNOTATION] == "1"
+        assert sched.pods_spilled == 1
+        # the re-stamped pod's home partition IS the spill target
+        assert c.pod_partition(live) == target
+
+    def test_spill_exhausts_after_visiting_every_partition(self):
+        server = APIServer()
+        client, pod = self._pod_on_server(server)
+        sched = _FakeSched()
+        c = PartitionCoordinator(
+            client, sched, _config(num_partitions=3), "s1"
+        )
+        c.held = {0: 1}
+        live = pod
+        for _ in range(2):  # P - 1 hops available
+            assert c.try_spill(live)
+            live = client.get_pod("default", pod.metadata.name)
+        assert not c.try_spill(live), "spilled past every partition"
+        assert sched.pods_spilled == 2
+
+    def test_no_spill_single_partition_or_all_held(self):
+        server = APIServer()
+        client, pod = self._pod_on_server(server)
+        sched = _FakeSched()
+        c1 = PartitionCoordinator(
+            client, sched, _config(num_partitions=1), "s1"
+        )
+        assert not c1.try_spill(pod)
+        c2 = PartitionCoordinator(
+            client, sched, _config(num_partitions=2), "s1"
+        )
+        c2.held = {0: 1, 1: 1}
+        assert not c2.try_spill(pod)
+
+    def test_spill_aborts_on_already_bound(self):
+        from kubernetes_tpu.api.types import Binding
+
+        server = APIServer()
+        client, pod = self._pod_on_server(server)
+        client.create_node(
+            make_node("nX").capacity(cpu="4", memory="8Gi", pods=10).obj()
+        )
+        client.bind(Binding(
+            pod_namespace="default", pod_name=pod.metadata.name,
+            pod_uid=pod.metadata.uid, target_node="nX",
+        ))
+        sched = _FakeSched()
+        c = PartitionCoordinator(
+            client, sched, _config(num_partitions=3), "s1"
+        )
+        c.held = {c.pod_partition(pod): 1}
+        live = client.get_pod("default", pod.metadata.name)
+        assert c.try_spill(live)  # handled: nothing left to do
+        assert sched.pods_spilled == 0  # but not counted as a spill
+        live2 = client.get_pod("default", pod.metadata.name)
+        assert SPILL_TARGET_ANNOTATION not in live2.metadata.annotations
+
+
+class TestTypedConflictsAndAuthority:
+    def test_already_bound_is_typed(self):
+        from kubernetes_tpu.api.types import Binding
+
+        server = APIServer()
+        client = Client(server)
+        pod = make_pod("c1").container(cpu="100m", memory="128Mi").obj()
+        client.create_pod(pod)
+        client.bind(Binding(
+            pod_namespace="default", pod_name="c1",
+            pod_uid=pod.metadata.uid, target_node="nA",
+        ))
+        with pytest.raises(BindConflict) as ei:
+            client.bind(Binding(
+                pod_namespace="default", pod_name="c1",
+                pod_uid=pod.metadata.uid, target_node="nB",
+            ))
+        assert ei.value.kind == "already-bound"
+        assert ei.value.current_node == "nA"
+        assert isinstance(ei.value, Conflict)  # old handlers still catch
+
+    def test_bind_assumed_bulk_authority_remaps_indexes(self):
+        server = APIServer()
+        client = Client(server)
+        cfg = _config(num_partitions=2)
+        server.install_partition_authority(
+            PartitionAuthority(server, cfg, clock=time.monotonic)
+        )
+        # stack s1 holds only partition 0 (live lease); s2 holds 1
+        now = time.monotonic()
+        for k, holder in ((0, "s1"), (1, "s2")):
+            server.create(Lease(
+                metadata=ObjectMeta(
+                    name=f"ksp-partition-{k}", namespace="kube-system"
+                ),
+                holder_identity=holder, lease_duration_seconds=30.0,
+                renew_time=now,
+            ))
+        nodes_p0 = [
+            f"n{i}" for i in range(20) if partition_of_name(f"n{i}", 2) == 0
+        ][:2]
+        nodes_p1 = [
+            f"n{i}" for i in range(20) if partition_of_name(f"n{i}", 2) == 1
+        ][:2]
+        assumed = []
+        want_conflict = []
+        for i, node in enumerate(
+            [nodes_p0[0], nodes_p1[0], nodes_p0[1], nodes_p1[1]]
+        ):
+            pod = make_pod(f"b{i}").container(
+                cpu="100m", memory="128Mi"
+            ).obj()
+            client.create_pod(pod)
+            clone = pod.assumed_clone()
+            clone.spec.node_name = node
+            assumed.append(clone)
+            if partition_of_name(node, 2) == 1:
+                want_conflict.append(i)
+        errors = server.bind_assumed_bulk(assumed, binder="s1")
+        got = sorted(i for i, _e in errors)
+        assert got == want_conflict
+        for _i, e in errors:
+            assert isinstance(e, BindConflict)
+            assert e.kind == "foreign-partition"
+        # owned slots actually bound
+        for i, a in enumerate(assumed):
+            live = client.get_pod("default", a.metadata.name)
+            if i in want_conflict:
+                assert not live.spec.node_name
+            else:
+                assert live.spec.node_name == a.spec.node_name
+
+    def test_expired_foreign_lease_allows_bind(self):
+        server = APIServer()
+        cfg = _config(num_partitions=1)
+        auth = PartitionAuthority(server, cfg, clock=time.monotonic)
+        server.create(Lease(
+            metadata=ObjectMeta(
+                name="ksp-partition-0", namespace="kube-system"
+            ),
+            holder_identity="dead-stack", lease_duration_seconds=0.01,
+            renew_time=time.monotonic() - 10.0,
+        ))
+        assert auth.check("adopter", "any-node") is None
+        # a LIVE foreign holder refuses
+        def mutate(obj):
+            obj.renew_time = time.monotonic()
+            obj.lease_duration_seconds = 30.0
+
+        server.guaranteed_update(
+            "Lease", "kube-system", "ksp-partition-0", mutate
+        )
+        assert auth.check("adopter", "any-node") == "foreign-partition"
+        assert auth.check("dead-stack", "any-node") is None
+
+
+class TestWatchCursor:
+    def test_multiple_watchers_share_one_log(self):
+        server = APIServer()
+        w1 = server.watch("Pod")
+        w2 = server.watch("Pod")
+        for i in range(5):
+            server.create(
+                make_pod(f"w{i}").container(cpu="1m", memory="1Mi").obj()
+            )
+        assert len(w1.pending()) == 5
+        assert len(w2.pending()) == 5  # independent cursors, one log
+        assert w1.pending() == []
+        w1.stop()
+        w2.stop()
+
+    def test_lagged_watcher_goes_gone_after_trim(self):
+        server = APIServer(watch_history_limit=10)
+        w = server.watch("Pod")
+        for i in range(30):  # trims fire; the idle cursor falls behind
+            server.create(
+                make_pod(f"g{i}").container(cpu="1m", memory="1Mi").obj()
+            )
+        with pytest.raises(Gone):
+            w.pending()
+
+    def test_live_watcher_survives_trims(self):
+        server = APIServer(watch_history_limit=10)
+        w = server.watch("Pod")
+        seen = 0
+        for i in range(40):
+            server.create(
+                make_pod(f"l{i}").container(cpu="1m", memory="1Mi").obj()
+            )
+            seen += len(w.pending())
+        assert seen == 40
+        w.stop()
+
+
+class TestPartitionConfig:
+    def test_loader_parses_partition_block(self):
+        from kubernetes_tpu.config.loader import load_config_from_dict
+        from kubernetes_tpu.config.validation import validate_config
+
+        cfg = load_config_from_dict({
+            "partition": {
+                "enabled": True,
+                "numPartitions": 4,
+                "leaseDuration": "750ms",
+                "retryPeriod": 0.05,
+                "zoneAligned": True,
+                "resourcePrefix": "my-part",
+            }
+        })
+        pt = cfg.partition
+        assert pt.enabled and pt.num_partitions == 4
+        assert pt.lease_duration_seconds == pytest.approx(0.75)
+        assert pt.retry_period_seconds == pytest.approx(0.05)
+        assert pt.zone_aligned
+        assert pt.resource_prefix == "my-part"
+        assert validate_config(cfg) == []
+
+    def test_validation_rejects_bad_partition(self):
+        from kubernetes_tpu.config.loader import load_config_from_dict
+        from kubernetes_tpu.config.validation import validate_config
+
+        cfg = load_config_from_dict(
+            {"partition": {"enabled": True, "numPartitions": 0}}
+        )
+        assert any("numPartitions" in e for e in validate_config(cfg))
+        cfg = load_config_from_dict({
+            "partition": {
+                "enabled": True, "leaseDuration": 0.1, "retryPeriod": 0.2,
+            }
+        })
+        assert any("retryPeriod" in e for e in validate_config(cfg))
+        cfg = load_config_from_dict({
+            "partition": {"enabled": True},
+            "leaderElection": {"leaderElect": True},
+        })
+        assert any("mutually exclusive" in e for e in validate_config(cfg))
+
+    def test_band_priority_class_parses(self):
+        from kubernetes_tpu.config.loader import load_config_from_dict
+
+        cfg = load_config_from_dict({
+            "streaming": {"enabled": True, "bandPriorityClass": "critical"}
+        })
+        assert cfg.streaming.band_priority_class == "critical"

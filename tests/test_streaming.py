@@ -297,6 +297,90 @@ class TestAutoBatchController:
             AutoBatchController(slo_p99_seconds=0.0)
 
 
+def _overload_series(n=30, interval=0.25):
+    """The sustained-overload shape that used to make the controller
+    hunt between poles: a deep backlog whose max-batch drains
+    momentarily empty the visible queue every other interval, so the
+    RAW pressure signal whipsaws between saturation and idle."""
+    series = []
+    t, cycle = 0.0, 0
+    for step in range(n):
+        t += interval
+        if step % 2 == 0:
+            depth, cycle = 40000, cycle + 500
+        else:
+            depth, cycle = 50, cycle + 4000
+        series.append((depth, cycle, t, 0.0))
+    return series
+
+
+class TestOverloadLatch:
+    def test_overload_trajectory_at_most_two_moves(self):
+        """ROADMAP item-2 residual b: the EWMA + latch pins the
+        controller at the throughput pole on a sustained-overload
+        series in <= 2 moves (one grow + the latch's pole jump) where
+        the unsmoothed controller made ~10+ grow/shrink moves."""
+        c = AutoBatchController(
+            slo_p99_seconds=1.0, latency_batch=512, max_batch=4096
+        )
+        _drive(c, _overload_series())
+        assert c.latched
+        assert c.grows + c.shrinks <= 2, (c.grows, c.shrinks)
+        assert c.window == c.max_window
+        assert c.batch_cap == c.max_batch
+
+    def test_unsmoothed_unlatch_controller_hunts(self):
+        """The regression witness: alpha=1 (no smoothing) with the
+        latch disabled reproduces the pole-hunting this satellite
+        fixes -- if this stops hunting, the overload series no longer
+        exercises the seam and the latch test above proves nothing."""
+        c = AutoBatchController(
+            slo_p99_seconds=1.0, latency_batch=512, max_batch=4096,
+            pressure_ewma_alpha=1.0, latch_after_steps=10 ** 9,
+        )
+        _drive(c, _overload_series())
+        assert not c.latched
+        assert c.grows + c.shrinks >= 10, (c.grows, c.shrinks)
+
+    def test_latch_releases_after_sustained_calm(self):
+        c = AutoBatchController(
+            slo_p99_seconds=1.0, latency_batch=512, max_batch=4096,
+        )
+        _drive(c, _overload_series(n=10))
+        assert c.latched
+        # sustained calm: shallow queue, healthy drain rate
+        t0 = 10 * 0.25
+        calm = [
+            (10, 4000 * 10 + 1000 * (i + 1), t0 + 0.25 * (i + 1), 0.0)
+            for i in range(20)
+        ]
+        _drive(c, calm)
+        assert not c.latched
+        assert c.batch_cap == c.latency_batch  # shrinks resumed
+
+    def test_latch_respects_idle_dispatcher_guard(self):
+        """Depth piling up while the dispatcher is BLOCKED on arrivals
+        is not overload: neither grow nor latch may fire."""
+        c = AutoBatchController(slo_p99_seconds=1.0, max_batch=4096)
+        pw = 0.0
+        series = []
+        for i in range(20):
+            pw += 0.25
+            series.append((5000, 100 * (i + 1), 0.25 * (i + 1), pw))
+        _drive(c, series)
+        assert not c.latched
+        assert c.grows == 0
+
+    def test_deterministic(self):
+        a = AutoBatchController(slo_p99_seconds=1.0, max_batch=4096)
+        b = AutoBatchController(slo_p99_seconds=1.0, max_batch=4096)
+        s = _overload_series(n=40)
+        assert _drive(a, s) == _drive(b, s)
+        assert (a.latched, a.latches, a.pressure_ewma) == (
+            b.latched, b.latches, b.pressure_ewma
+        )
+
+
 # -- config wiring -----------------------------------------------------------
 
 

@@ -31,7 +31,15 @@ Prints ONE JSON line:
    "member_churn_rows":  K (5% of M, the rows each step touched),
    "member_full_repack_ms":
                          the RETIRED pre-PR-6 membership path (full
-                         M-row repack), for scale}
+                         M-row repack), for scale,
+   "watch_fanout_{perevent,bulk}_{1,4}w_ms":
+                         apiserver watch fan-out: 20k pod events
+                         broadcast to 1 vs 4 concurrent watchers,
+                         per-event vs batched delivery. With the
+                         shared-log cursor design (PR 8) the 4-watcher
+                         cost tracks the 1-watcher cost (broadcast is
+                         O(events), watcher-count independent) and
+                         batched delivery beats per-event ~4x}
 
 Usage: python tools/bench_hotpath.py [--pods 10000] [--nodes 5000]
 """
@@ -336,6 +344,70 @@ def bench_membership_churn(num_nodes, churn_fraction=0.05):
     return out
 
 
+def bench_watch_fanout(events: int = 20000):
+    """Apiserver watch fan-out under N consumers (the partitioned
+    control plane runs one full informer set PER STACK): broadcast
+    ``events`` pod creates with 1 vs 4 open watchers, per-event
+    (create) vs batched (create_bulk) delivery, watchers draining
+    concurrently. With the shared-log cursor design the broadcast cost
+    is O(events) regardless of watcher count -- the 4-watcher runs
+    should track the 1-watcher runs, and batched delivery should beat
+    per-event on the producer side (one log extend + one wakeup per
+    transaction)."""
+    import threading
+
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.testing import make_pod
+
+    out = {}
+    for watchers in (1, 4):
+        for batched in (False, True):
+            server = APIServer()
+            ws = [server.watch("Pod") for _ in range(watchers)]
+            drained = [0] * watchers
+            stop = threading.Event()
+
+            def drain(i, w):
+                while not stop.is_set() or drained[i] < events:
+                    evs = w.next_batch(timeout=0.05)
+                    drained[i] += len(evs)
+                    if drained[i] >= events:
+                        return
+
+            threads = [
+                threading.Thread(target=drain, args=(i, w), daemon=True)
+                for i, w in enumerate(ws)
+            ]
+            for t in threads:
+                t.start()
+            pods = [
+                make_pod(f"wf-{i}").container(cpu="1m", memory="1Mi").obj()
+                for i in range(events)
+            ]
+            t0 = time.perf_counter()
+            if batched:
+                for i in range(0, events, 256):
+                    server.create_bulk(pods[i:i + 256])
+            else:
+                for p in pods:
+                    server.create(p)
+            produce_ms = (time.perf_counter() - t0) * 1000
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            total_ms = (time.perf_counter() - t0) * 1000
+            assert all(d >= events for d in drained), drained
+            key = (
+                f"watch_fanout_{'bulk' if batched else 'perevent'}"
+                f"_{watchers}w"
+            )
+            out[key + "_produce_ms"] = produce_ms
+            out[key + "_ms"] = total_ms
+            for w in ws:
+                w.stop()
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--pods", type=int, default=10000)
@@ -365,6 +437,7 @@ def main() -> None:
     gather_ms, assume_ms = bench_commit(pods, node_names)
     node_state = bench_node_state(args.nodes)
     member = bench_membership_churn(args.nodes)
+    fanout = bench_watch_fanout()
 
     record = {
         "metric": "hotpath_microbench",
@@ -389,6 +462,7 @@ def main() -> None:
             for k, v in member.items()
         }
     )
+    record.update({k: round(v, 2) for k, v in fanout.items()})
     print(json.dumps(record))
 
 
