@@ -451,6 +451,10 @@ def _open_loop_stack(num_nodes, max_batch, policy, slo_s):
             slo_p99_seconds=slo_s,
             latency_batch=min(512, max_batch),
             max_batch=max_batch,
+            # rung LADDER sized from the measured per-pad solve cost at
+            # warmup (calibrate prunes candidates that don't pay); the
+            # open-loop bench is where mid-ladder rungs earn their keep
+            auto_rungs=True,
         )
         sched.attach_autobatch(controller)
     elif policy == "latency-static":

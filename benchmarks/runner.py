@@ -24,6 +24,20 @@ from typing import Any, Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# mesh workloads on a CPU box: KTPU_FORCE_HOST_DEVICES=8 splits the host
+# platform into N virtual devices so the sharded path runs for real.
+# Must land before jax initializes its backends (the kubernetes_tpu
+# imports below pull jax in), and is a no-op on multi-chip hardware
+# (jax.devices() returns the accelerators regardless).
+_force_devs = os.environ.get("KTPU_FORCE_HOST_DEVICES")
+if _force_devs and "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={int(_force_devs)}"
+    ).strip()
+
 from kubernetes_tpu.api.types import (
     POD_GROUP_LABEL,
     ObjectMeta,
@@ -372,6 +386,23 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
     client = Client(server)
     informers = InformerFactory(server)
     solver_cfg = GreedyConfig(**wl["solver"]) if wl.get("solver") else None
+    # workload-scoped node-axis mesh (the sharded delta path): the
+    # requested device count is CLAMPED to what this process actually
+    # has, so the matrix stays runnable on a 1-chip box (mesh of 1) and
+    # uses the full mesh on multi-chip hardware. CPU boxes can force
+    # virtual devices with KTPU_FORCE_HOST_DEVICES=N (read before jax
+    # initializes, see main()).
+    mesh = None
+    mesh_devices = int(wl.get("mesh_devices", 0))
+    if mesh_devices > 0:
+        import jax
+        from jax.sharding import Mesh
+
+        import numpy as _np
+
+        devs = jax.devices()
+        mesh_devices = min(mesh_devices, len(devs))
+        mesh = Mesh(_np.array(devs[:mesh_devices]), axis_names=("nodes",))
     sched = new_scheduler(
         client,
         informers,
@@ -379,6 +410,7 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         max_batch=max_batch,
         solver_config=solver_cfg,
         solver_mode=wl.get("solver_mode", "greedy"),
+        mesh=mesh,
     )
 
     # workload-scoped open-loop streaming (kubernetes_tpu/streaming/):
@@ -406,6 +438,7 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
                 latency_batch=streaming.latency_batch,
                 max_batch=max_batch,
                 interval_seconds=streaming.controller_interval_seconds,
+                auto_rungs=getattr(streaming, "auto_rungs", False),
             )
             sched.attach_autobatch(controller)
         if streaming.band_priority_threshold is not None:
@@ -867,6 +900,7 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
                 "max": round(max(utils), 4),
             }
         result["solver"] = {
+            "mesh_devices": mesh_devices,
             "batches": sched.batches_solved,
             "pods_on_device": sched.pods_solved_on_device,
             "pods_fallback": sched.pods_fallback,

@@ -129,6 +129,7 @@ def streaming_from_dict(st_raw: Dict[str, Any]) -> StreamingConfiguration:
         min_window_seconds=_duration_seconds(st_raw.get("minWindow", 0.0)),
         max_window_seconds=_duration_seconds(st_raw.get("maxWindow", 0.25)),
         latency_batch=int(st_raw.get("latencyBatch", 512)),
+        auto_rungs=bool(st_raw.get("autoRungs", False)),
         controller_interval_seconds=_duration_seconds(
             st_raw.get("controllerInterval", 0.25)
         ),

@@ -175,6 +175,11 @@ class StreamingConfiguration:
     max_window_seconds: float = 0.25
     #: latency-mode dispatch cap (also the latency solve pad rung)
     latency_batch: int = 512
+    #: size the solve-pad rung ladder from the measured per-pad solve
+    #: cost at warmup (geometric candidates latencyBatch..maxBatch,
+    #: pruned by AutoBatchController.calibrate) instead of the
+    #: hardcoded two rungs; every surviving rung is pre-compiled
+    auto_rungs: bool = False
     controller_interval_seconds: float = 0.25
     # -- priority bands --------------------------------------------------
     #: pods with spec.priority >= this form the high band; None = off

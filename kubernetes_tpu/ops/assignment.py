@@ -345,6 +345,78 @@ _N_AFFINITY = 14
 _N_SCORING = 20
 
 
+def _unpack_buffer(buf: jnp.ndarray, layout: Tuple) -> dict:
+    """Re-slice the single uploaded int32 buffer into named arrays
+    (static offsets, free after fusion). ``kind`` restores dtypes: 'i'
+    int32, 'b' bool, 'f' float32 (bitcast -- float tensors ride the
+    int32 buffer bit-exactly); ``("Z*", fill)`` marks a ConstPiece
+    materialized on device as a free constant."""
+    arrs = {}
+    off = 0
+    for name, shape, kind in layout:
+        if isinstance(kind, tuple):
+            base, fill = kind
+            dt = {"Zi": jnp.int32, "Zf": jnp.float32, "Zb": bool}[base]
+            arrs[name] = jnp.full(shape, fill, dtype=dt)
+            continue
+        size = 1
+        for d in shape:
+            size *= d
+        a = buf[off:off + size].reshape(shape)
+        if kind == "b":
+            a = a.astype(bool)
+        elif kind == "f":
+            a = jax.lax.bitcast_convert_type(a, jnp.float32)
+        arrs[name] = a
+        off += size
+    return arrs
+
+
+def shard_local_row_set(
+    state: jnp.ndarray,  # [N, ...] node-axis leading
+    idx: jnp.ndarray,  # [K] global row indices (>= N = padding, drops)
+    rows: jnp.ndarray,  # [K, ...] replacement rows (replicated)
+) -> jnp.ndarray:
+    """Scatter ``rows`` onto ``state`` with shard-LOCAL arithmetic: each
+    node row decides elementwise whether one of the K slots targets it,
+    so under a node-axis sharding every shard resolves only its own rows
+    against the small replicated (idx, rows) operands -- no cross-shard
+    traffic (every global row index maps to exactly one shard-local
+    row). The dense `.at[].set` scatter is kept on the single-device
+    path; this formulation is the mesh twin's, where GSPMD must not be
+    tempted into gather/scatter collectives."""
+    n = state.shape[0]
+    onehot = idx[None, :] == jnp.arange(n, dtype=idx.dtype)[:, None]  # [N, K]
+    hit = onehot.any(axis=1)
+    picked = rows[jnp.argmax(onehot, axis=1)].astype(state.dtype)  # [N, ...]
+    mask = hit.reshape((n,) + (1,) * (state.ndim - 1))
+    return jnp.where(mask, picked, state)
+
+
+def _apply_row_patches(arrs, alloc, valid, req_state, nzr_state, shard_local):
+    """Row-delta scatter (the steady-state patch path): changed node rows
+    ride the same single upload buffer as (indices, rows) and are
+    scattered onto the device-RESIDENT state here, so external churn
+    costs O(changed rows) on the serving link instead of a full [N, R]
+    re-upload. Padding slots carry index >= N and drop."""
+    setter = (
+        shard_local_row_set
+        if shard_local
+        else (lambda s, i, r: s.at[i].set(r.astype(s.dtype), mode="drop"))
+    )
+    if "didx" in arrs:
+        didx = arrs["didx"]
+        req_state = setter(req_state, didx, arrs["dreq"])
+        nzr_state = setter(nzr_state, didx, arrs["dnzr"])
+    if "sidx" in arrs:
+        alloc = setter(alloc, arrs["sidx"], arrs["salloc"])
+        if "svalid" in arrs:
+            # membership churn: retired/claimed row slots also flip the
+            # resident valid mask (padding slots carry index >= N, drop)
+            valid = setter(valid, arrs["sidx"], arrs["svalid"].astype(bool))
+    return alloc, valid, req_state, nzr_state
+
+
 @partial(
     jax.jit,
     static_argnames=("layout", "config", "mode", "use_pallas", "caps"),
@@ -368,51 +440,31 @@ def _solve_packed_jit(
     the batch's 5-9 arrays -- and >1s for a constrained batch's ~40
     family tensors when host Python contends for the link); concatenating
     the per-batch upload into one int32 buffer makes it one transfer and
-    this wrapper re-slices it on device (static offsets, free after
-    fusion). ``kind`` restores dtypes: 'i' int32, 'b' bool, 'f' float32
-    (bitcast -- float tensors ride the int32 buffer bit-exactly).
+    this wrapper re-slices it on device (``_unpack_buffer``).
     Returns (assignment, requested', nzr', allocatable, valid) -- the
     last two so the caller can keep device-resident refs when they rode
     the buffer."""
-    arrs = {}
-    off = 0
-    for name, shape, kind in layout:
-        if isinstance(kind, tuple):
-            base, fill = kind
-            dt = {"Zi": jnp.int32, "Zf": jnp.float32, "Zb": bool}[base]
-            arrs[name] = jnp.full(shape, fill, dtype=dt)
-            continue
-        size = 1
-        for d in shape:
-            size *= d
-        a = buf[off:off + size].reshape(shape)
-        if kind == "b":
-            a = a.astype(bool)
-        elif kind == "f":
-            a = jax.lax.bitcast_convert_type(a, jnp.float32)
-        arrs[name] = a
-        off += size
+    arrs = _unpack_buffer(buf, layout)
     alloc = arrs["alloc"] if "alloc" in arrs else alloc_in
     valid = arrs["valid"].astype(bool) if "valid" in arrs else valid_in
     req_state = arrs["req_state"] if "req_state" in arrs else req_in
     nzr_state = arrs["nzr_state"] if "nzr_state" in arrs else nzr_in
-    # row-delta scatter (the steady-state patch path): changed node rows
-    # ride the same single upload buffer as (indices, rows) and are
-    # scattered onto the device-RESIDENT state here, so external churn
-    # costs O(changed rows) on the serving link instead of a full [N, R]
-    # re-upload. Padding slots carry index >= N and drop.
-    if "didx" in arrs:
-        didx = arrs["didx"]
-        req_state = req_state.at[didx].set(arrs["dreq"], mode="drop")
-        nzr_state = nzr_state.at[didx].set(arrs["dnzr"], mode="drop")
-    if "sidx" in arrs:
-        alloc = alloc.at[arrs["sidx"]].set(arrs["salloc"], mode="drop")
-        if "svalid" in arrs:
-            # membership churn: retired/claimed row slots also flip the
-            # resident valid mask (padding slots carry index >= N, drop)
-            valid = valid.at[arrs["sidx"]].set(
-                arrs["svalid"].astype(bool), mode="drop"
-            )
+    alloc, valid, req_state, nzr_state = _apply_row_patches(
+        arrs, alloc, valid, req_state, nzr_state, shard_local=False
+    )
+    return _packed_solve_tail(
+        arrs, alloc, valid, req_state, nzr_state, config, mode,
+        use_pallas, caps,
+    )
+
+
+def _packed_solve_tail(
+    arrs, alloc, valid, req_state, nzr_state, config, mode, use_pallas,
+    caps,
+):
+    """Solver dispatch shared by the single-device jit and its sharded
+    mesh twin: pick the solver for (mode, use_pallas) and run it on the
+    (possibly row-patched) node state."""
     pod_req = arrs["req"]
     pod_nzr_ = arrs["nzr"]
     midx = arrs["midx"]
@@ -453,6 +505,78 @@ def _solve_packed_jit(
         active, config=config,
     )
     return assignment, req_out, nzr_out, alloc, valid
+
+
+#: one jitted sharded twin per Mesh (BatchScheduler holds one mesh for
+#: its lifetime; tests/benches may build a few)
+_MESH_PACKED_JIT: dict = {}
+
+
+def make_mesh_packed_solver(mesh: "jax.sharding.Mesh"):
+    """The sharded twin of ``_solve_packed_jit`` for one mesh: the same
+    single-buffer layout contract, with the resident node state
+    (requested/nzr/allocatable/valid) living SHARDED over the ``nodes``
+    mesh axis and the steady-state row-delta scatter applied shard-
+    locally (``shard_local_row_set``). Output shardings are pinned so
+    one step's carry feeds the next step's inputs with no resharding
+    (SNIPPETS.md pjit guidance: ``out_axis_resources`` of step k ==
+    ``in_axis_resources`` of step k+1). One jitted instance per mesh,
+    cached -- its signature count is observable via
+    ``mesh_packed_cache_size`` (the dryrun's zero-recompile probe)."""
+    fn = _MESH_PACKED_JIT.get(mesh)
+    if fn is not None:
+        return fn
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    node = NamedSharding(mesh, P("nodes"))
+    node2d = NamedSharding(mesh, P("nodes", None))
+    rows_sh = NamedSharding(mesh, P(None, "nodes"))
+
+    @partial(jax.jit, static_argnames=("layout", "config", "mode"))
+    def solve(
+        buf, alloc_in, valid_in, req_in, nzr_in, layout,
+        config=GreedyConfig(), mode="greedy",
+    ):
+        arrs = _unpack_buffer(buf, layout)
+        alloc = arrs["alloc"] if "alloc" in arrs else alloc_in
+        valid = arrs["valid"].astype(bool) if "valid" in arrs else valid_in
+        req_state = arrs["req_state"] if "req_state" in arrs else req_in
+        nzr_state = arrs["nzr_state"] if "nzr_state" in arrs else nzr_in
+        alloc, valid, req_state, nzr_state = _apply_row_patches(
+            arrs, alloc, valid, req_state, nzr_state, shard_local=True
+        )
+        # pin the node-axis layout: cold uploads (riding the replicated
+        # buffer) reshard HERE once, steady dispatches enter already
+        # sharded and the constraints are no-ops
+        alloc = jax.lax.with_sharding_constraint(alloc, node2d)
+        valid = jax.lax.with_sharding_constraint(valid, node)
+        req_state = jax.lax.with_sharding_constraint(req_state, node2d)
+        nzr_state = jax.lax.with_sharding_constraint(nzr_state, node2d)
+        arrs["rows"] = jax.lax.with_sharding_constraint(
+            arrs["rows"], rows_sh
+        )
+        assignment, req_out, nzr_out, alloc, valid = _packed_solve_tail(
+            arrs, alloc, valid, req_state, nzr_state, config, mode,
+            use_pallas=False, caps=None,
+        )
+        req_out = jax.lax.with_sharding_constraint(req_out, node2d)
+        nzr_out = jax.lax.with_sharding_constraint(nzr_out, node2d)
+        return assignment, req_out, nzr_out, alloc, valid
+
+    _MESH_PACKED_JIT[mesh] = solve
+    return solve
+
+
+def mesh_packed_cache_size(mesh) -> int:
+    """Compiled-signature count of the mesh's packed solver: the
+    multichip dryrun probes this before/after the steady phase so a
+    second-signature regression (a mid-run recompile on the mesh hot
+    path) fails loudly instead of silently eating a multi-second GSPMD
+    compile inside a measured window."""
+    fn = _MESH_PACKED_JIT.get(mesh)
+    if fn is None:
+        return 0
+    return int(fn._cache_size())
 
 
 @jax.jit
@@ -617,6 +741,7 @@ def solve_packed(
     config: GreedyConfig = GreedyConfig(),
     mode: str = "greedy",
     allow_pallas: bool = True,
+    mesh=None,
 ):
     """Host-side companion of _solve_packed_jit: concatenates the pieces
     (int32 / bool / float32 -- see _solve_packed_jit's kind codes) and
@@ -627,7 +752,13 @@ def solve_packed(
     packed pieces and gate on an explicit VMEM estimate -- node count,
     mask-row diversity U, score-signature count S and zone count all
     contribute, so a batch that cannot fit falls back to the XLA scan
-    instead of failing Mosaic compilation (ADVICE r4)."""
+    instead of failing Mosaic compilation (ADVICE r4).
+
+    ``mesh``: a ``jax.sharding.Mesh`` with a "nodes" axis routes the
+    solve through the sharded twin (``make_mesh_packed_solver``): the
+    batch buffer uploads replicated, the resident node state stays
+    sharded over the node axis, and the Pallas kernels (whole-array
+    single-core programs) are never attempted."""
     import numpy as _np
 
     layout = tuple(
@@ -642,6 +773,7 @@ def solve_packed(
     use_pallas = (
         allow_pallas  # the degradation ladder's xla tier forces this off
         # when the pallas breaker is open (robustness/ladder.py)
+        and mesh is None
         and pallas_candidate(mode, b, n_cap, r_dims, u_rows)
     )
     caps = None
@@ -678,6 +810,14 @@ def solve_packed(
             if not isinstance(arr, ConstPiece)
         ]
     )
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        buf_d = jax.device_put(buf, NamedSharding(mesh, P()))
+        return make_mesh_packed_solver(mesh)(
+            buf_d, alloc_in, valid_in, req_in, nzr_in,
+            layout=layout, config=config, mode=mode,
+        )
     buf_d = jax.device_put(buf)
     try:
         return _solve_packed_jit(
@@ -1098,6 +1238,12 @@ def make_sharded_solver(mesh: "jax.sharding.Mesh", config: GreedyConfig = Greedy
     operands are replicated, and XLA inserts the ICI collectives for the
     cross-shard argmax inside the scan. N must be a multiple of the mesh
     size (NodeTensorCache pads to 128 rows).
+
+    This is the raw stateless kernel (the dryrun drives it directly);
+    the production scheduler instead rides the DEVICE-RESIDENT CARRY
+    variant -- ``make_mesh_packed_solver`` -- where the sharded node
+    state stays on the mesh between batches and steady-state dispatch
+    ships only the fixed per-shard delta scatter.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -347,12 +347,27 @@ class BatchScheduler(Scheduler):
         self.dispatch_batch_cap: Optional[int] = None
         self.solve_pad: Optional[int] = None
         # solve-pad shapes warmup() pre-compiles beyond max_batch
-        # (attach_autobatch adds the controller's latency rung)
+        # (attach_autobatch adds every controller rung)
         self._warmup_pads: set = {max_batch}
+        # measured steady-solve seconds per warmed pad (warmup fills
+        # this post-compile); feeds AutoBatchController.calibrate so
+        # the rung ladder is sized from what each pad actually costs
+        self.pad_solve_seconds: dict = {}
         if solver_mode not in ("greedy", "sinkhorn"):
             raise ValueError(f"unknown solver_mode {solver_mode!r}")
         self.solver_mode = solver_mode
         self.mesh = mesh
+        # sharded mesh delta path (PR 9): the mesh dispatch rides the
+        # same single-buffer + device-resident-carry + delta-scatter
+        # machinery as the single-device path, through the sharded twin
+        # (ops/assignment.make_mesh_packed_solver) with shard-local row
+        # scatters. KTPU_MESH_DELTA=0 restores the PR-5 counted
+        # full-upload fallback (the escape hatch the
+        # allow_scatter=False seam in _negotiate_device_state serves).
+        self.mesh_delta = (
+            mesh is not None
+            and os.environ.get("KTPU_MESH_DELTA", "1") != "0"
+        )
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -713,10 +728,15 @@ class BatchScheduler(Scheduler):
         the fused kernel (shared predicate ops.assignment
         .pallas_candidate) -- otherwise a shape-ineligible batch would
         run the identical XLA solve twice on failure and charge it to
-        the pallas breaker. The XLA scan is always available."""
+        the pallas breaker. The XLA scan is always available. A mesh
+        never offers pallas: the fused kernels are whole-array
+        single-core programs, while mesh solves are GSPMD-partitioned
+        XLA lowerings."""
         from kubernetes_tpu.ops.assignment import pallas_candidate
 
-        if pallas_candidate(mode, b, n_cap, r_dims, u_rows):
+        if self.mesh is None and pallas_candidate(
+            mode, b, n_cap, r_dims, u_rows
+        ):
             return [TIER_PALLAS, TIER_XLA]
         return [TIER_XLA]
 
@@ -852,11 +872,17 @@ class BatchScheduler(Scheduler):
 
     def attach_autobatch(self, controller) -> None:
         """Wire an AutoBatchController (streaming/autobatch.py) into the
-        dispatch loop: its latency-mode solve pad joins the warmup
-        compile set so rung switches never pay JIT latency mid-run, and
-        its current outputs are applied immediately."""
+        dispatch loop: EVERY controller rung joins the warmup compile
+        set so rung switches never pay JIT latency mid-run (warmup also
+        measures each rung's solve cost, and the controller's
+        ``calibrate`` prunes rungs that don't pay), and the controller's
+        current outputs are applied immediately."""
         self.autobatch = controller
-        self._warmup_pads.add(int(controller.latency_batch))
+        for rung in getattr(
+            controller, "rungs",
+            (controller.latency_batch, controller.max_batch),
+        ):
+            self._warmup_pads.add(int(rung))
         self._warmup_pads.add(int(controller.max_batch))
         self.batch_window = controller.window
         self.dispatch_batch_cap = controller.batch_cap
@@ -1131,9 +1157,12 @@ class BatchScheduler(Scheduler):
           AND valid, didx resets the slot's requested state -- and is an
           EXPECTED reset, never counted as a divergence.
         - not carry_ok: full [N, R] requested upload (``state_uploads``);
-          not static_ok additionally re-uploads allocatable+valid. The
-          mesh path passes ``allow_scatter=False`` and always resolves
-          changes this way (explicit counted full-upload fallback).
+          not static_ok additionally re-uploads allocatable+valid.
+
+        The mesh path rides the same scatters through the sharded twin
+        (each delta row lands on exactly one node shard);
+        ``allow_scatter=False`` is the KTPU_MESH_DELTA=0 escape hatch
+        that restores the PR-5 counted full-upload fallback.
         """
         ds = self._dev
         d = nt.delta
@@ -1507,15 +1536,31 @@ class BatchScheduler(Scheduler):
         b = batch.size
         # fixed solve shape: every batch pads to max_batch so the solver
         # JITs exactly once per (node-bucket, variant). The adaptive
-        # controller may floor the pad at its latency rung instead --
-        # small batches then run a proportionally cheaper solve -- but
-        # only when the batch actually fits the rung (a deferred
-        # preemption wave can exceed the cap and falls back to the
-        # max_batch signature), so the signature set stays exactly
-        # {latency rung, max_batch} plus the defensive oversize bucket.
+        # controller may floor the pad at its current rung instead --
+        # small batches then run a proportionally cheaper solve -- so
+        # the signature set is {warmed rungs} + {max_batch} plus the
+        # defensive oversize bucket. Warmup compiles the BASIC layouts
+        # for every rung; constrained layouts warm at max_batch only
+        # (the pre-existing latency-rung tradeoff: rare enough that
+        # the one-time compile lands on demand), so a batch whose
+        # aggregates say constraint families may pack never ESCALATES
+        # to a mid rung -- it takes the max_batch signature as before.
         pad_floor = self.solve_pad
         if not pad_floor or b > pad_floor:
-            pad_floor = self.max_batch
+            # escalate to the smallest pre-compiled rung that fits
+            # (ladder-aware: an oversize plain batch lands on the next
+            # warmed rung up instead of jumping straight to the
+            # max_batch signature); anything past every warmed rung,
+            # or possibly-constrained, takes the max_batch signature
+            may_constrain = (
+                has_hard_spread or has_affinity or score_dynamic
+                or has_scoring_terms
+            )
+            fitting = [p for p in self._warmup_pads if p >= b]
+            pad_floor = (
+                min(fitting) if fitting and not may_constrain
+                else self.max_batch
+            )
         padded = max(
             pad_floor, POD_BUCKET * math.ceil(b / POD_BUCKET)
         )
@@ -1649,7 +1694,7 @@ class BatchScheduler(Scheduler):
         ds = self._dev
         neg = self._negotiate_device_state(
             nt, node_requested, node_nzr, overlaid,
-            allow_scatter=self.mesh is None,
+            allow_scatter=self.mesh is None or self.mesh_delta,
             pending_exists=self._pending_exists(),
         )
         if neg is None:
@@ -1664,14 +1709,18 @@ class BatchScheduler(Scheduler):
             )
         static_ok = neg["static_ok"]
         carry_ok = neg["carry_ok"]
-        if self.mesh is None:
+        if self.mesh is None or self.mesh_delta:
             # single-buffer upload: over the serving link every device_put
             # operand pays its own round trip (~40-90ms each); the whole
             # batch -- including a constrained batch's ~40 family count
             # tensors, which used to pay ~1s of per-leaf link round trips
             # under host CPU contention -- rides ONE int32 buffer,
             # re-sliced (and bitcast for float tensors) on device
-            # (ops/assignment.py solve_packed)
+            # (ops/assignment.py solve_packed). On a mesh the buffer
+            # uploads replicated while the resident node state stays
+            # SHARDED over the node axis; the delta-scatter slots apply
+            # shard-locally in the sharded twin, so steady-state churn
+            # costs O(DELTA_ROW_BUCKET) on the link regardless of N
             pieces = [
                 ("req", req),
                 ("nzr", nzr),
@@ -1700,9 +1749,18 @@ class BatchScheduler(Scheduler):
                 def fam_pieces(prefix, packed_arrs, noop_arrs):
                     """Present families ride the buffer; absent ones
                     become ConstPiece markers (free on-device constants
-                    instead of ~1MB of uploaded zeros/sentinels)."""
+                    instead of ~1MB of uploaded zeros/sentinels). On a
+                    MESH absent families ride as real zero arrays
+                    instead: every ConstPiece combo is its own layout
+                    (= its own multi-second GSPMD compile), and the
+                    mesh contract is ONE constrained jit signature per
+                    mesh shape -- the upload cost of the noop tensors
+                    is what the pre-delta mesh path always paid."""
                     if packed_arrs is not None:
                         for i, a in enumerate(packed_arrs):
+                            pieces.append((f"{prefix}{i}", np.asarray(a)))
+                    elif self.mesh is not None:
+                        for i, a in enumerate(noop_arrs):
                             pieces.append((f"{prefix}{i}", np.asarray(a)))
                     else:
                         for i, a in enumerate(noop_arrs):
@@ -1751,6 +1809,7 @@ class BatchScheduler(Scheduler):
                     config=self.solver_config,
                     mode=solve_mode,
                     allow_pallas=allow_pallas,
+                    mesh=self.mesh,
                 )
 
             def run_host_greedy():
@@ -1920,7 +1979,11 @@ class BatchScheduler(Scheduler):
                 "mask_index_solved": midx,
             }
 
-        # one batched host->device transfer for everything we must upload
+        # -- KTPU_MESH_DELTA=0 fallback: the PR-5 mesh path ----------------
+        # one batched host->device transfer for everything we must
+        # upload; every node-state change resolves as a counted full
+        # upload (allow_scatter=False above). Kept as the escape hatch
+        # for mesh shapes where the sharded-twin compile is suspect.
         to_upload = [req, nzr, rows, midx, active]
         shardings = None
         if self.mesh is not None:
@@ -2977,10 +3040,22 @@ class BatchScheduler(Scheduler):
         )
         for padded in [self.max_batch] + extra:
             self._warmup_at(nt, padded, full=padded == self.max_batch)
+        if self.autobatch is not None and hasattr(
+            self.autobatch, "calibrate"
+        ):
+            # rung-ladder calibration (ROADMAP item-2a residual): the
+            # controller drops candidate rungs whose measured solve
+            # cost is not meaningfully cheaper than the rung above --
+            # every surviving rung is already compiled by the loop
+            # above, so a rung switch never pays JIT mid-run
+            self.autobatch.calibrate(dict(self.pad_solve_seconds))
 
     def _warmup_at(self, nt, padded: int, full: bool) -> None:
         n = nt.capacity
         r = nt.dims.num_dims
+        if self.mesh is not None and self.mesh_delta:
+            self._warmup_mesh_packed(nt, padded, full)
+            return
         host = (
             nt.allocatable, nt.requested, nt.non_zero_requested, nt.valid,
             np.zeros((padded, r), dtype=np.int32),
@@ -3045,6 +3120,21 @@ class BatchScheduler(Scheduler):
                 config=self.solver_config, mode=self.solver_mode,
             )
             jax.block_until_ready(steady)
+            # measured per-pad solve cost (post-compile): feeds the
+            # AutoBatchController rung-ladder calibration, so the rungs
+            # reflect what THIS cluster shape actually pays per pad.
+            # Median of 3 -- a single sample absorbing a GC pause would
+            # prune a rung on one run and keep it on the next, making
+            # the ladder (and the controller trajectory) nondeterministic
+            samples = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                jax.block_until_ready(solve_packed(
+                    base + delta_slots, alloc_d, valid_d, req_d, nzr_d,
+                    config=self.solver_config, mode=self.solver_mode,
+                ))
+                samples.append(time.perf_counter() - t0)
+            self.pad_solve_seconds[padded] = sorted(samples)[1]
         if not full:
             # extra (latency-rung) pads warm the basic path only
             return
@@ -3118,6 +3208,95 @@ class BatchScheduler(Scheduler):
                     config=self.solver_config, mode="constrained",
                 )
                 jax.block_until_ready(out_one)
+
+    def _warmup_mesh_packed(self, nt, padded: int, full: bool) -> None:
+        """Sharded-twin warmup: compile every packed-upload layout the
+        MESH run loop can hit -- cold (static+carry ride the replicated
+        buffer, resharded once on device), carry-refresh, and
+        steady-state delta-scatter -- plus the single constrained
+        layout. Absent families ride as real zero tensors on the mesh
+        (fam_pieces), so the constrained dispatch has exactly ONE
+        signature per (state-variant, mesh shape): the multichip
+        dryrun's zero-recompile probe (mesh_packed_cache_size) pins
+        that the steady phase never compiles past this set. The steady
+        solve is re-run timed post-compile (pad_solve_seconds) for the
+        AutoBatchController rung ladder."""
+        n = nt.capacity
+        r = nt.dims.num_dims
+        base = [
+            ("req", np.zeros((padded, r), dtype=np.int32)),
+            ("nzr", np.zeros((padded, 2), dtype=np.int32)),
+            ("midx", np.zeros(padded, dtype=np.int32)),
+            ("active", np.zeros(padded, dtype=np.int32)),
+            ("rows", np.zeros((MASK_ROW_BUCKET, n), dtype=np.int32)),
+        ]
+        static_pieces = [
+            ("alloc", np.zeros((n, r), dtype=np.int32)),
+            ("valid", np.zeros(n, dtype=np.int32)),
+        ]
+        carry_pieces = [
+            ("req_state", np.zeros((n, r), dtype=np.int32)),
+            ("nzr_state", np.zeros((n, 2), dtype=np.int32)),
+        ]
+        delta_slots = _delta_slot_pieces(n, r)
+        kw = dict(
+            config=self.solver_config, mode=self.solver_mode,
+            mesh=self.mesh,
+        )
+        cold = solve_packed(
+            base + static_pieces + carry_pieces, None, None, None, None,
+            **kw,
+        )
+        jax.block_until_ready(cold)
+        _, _, _, alloc_d, valid_d = cold
+        refresh = solve_packed(
+            base + carry_pieces, alloc_d, valid_d, None, None, **kw
+        )
+        jax.block_until_ready(refresh)
+        _, req_d, nzr_d, _, _ = refresh
+        steady = solve_packed(
+            base + delta_slots, alloc_d, valid_d, req_d, nzr_d, **kw
+        )
+        jax.block_until_ready(steady)
+        # median of 3 (see _warmup_at): one noisy sample must not make
+        # the calibrated ladder nondeterministic run-to-run
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(solve_packed(
+                base + delta_slots, alloc_d, valid_d, req_d, nzr_d, **kw
+            ))
+            samples.append(time.perf_counter() - t0)
+        self.pad_solve_seconds[padded] = sorted(samples)[1]
+        if not full or n > CONSTRAINED_NODE_CAP:
+            # latency rungs warm the basic path only; over the
+            # constrained node cap every constrained batch routes host
+            return
+        noops = (
+            noop_spread_tensors(padded, n),
+            noop_affinity_tensors(padded, n),
+            noop_score_tensors(padded, n),
+        )
+        fam = (
+            [(f"sp{i}", np.asarray(a)) for i, a in enumerate(noops[0])]
+            + [(f"af{i}", np.asarray(a)) for i, a in enumerate(noops[1])]
+            + [(f"sc{i}", np.asarray(a)) for i, a in enumerate(noops[2])]
+        )
+        ckw = dict(
+            config=self.solver_config, mode="constrained", mesh=self.mesh,
+        )
+        jax.block_until_ready(solve_packed(
+            base + static_pieces + carry_pieces + fam,
+            None, None, None, None, **ckw,
+        ))
+        jax.block_until_ready(solve_packed(
+            base + carry_pieces + fam, alloc_d, valid_d, None, None,
+            **ckw,
+        ))
+        jax.block_until_ready(solve_packed(
+            base + delta_slots + fam, alloc_d, valid_d, req_d, nzr_d,
+            **ckw,
+        ))
 
     # -- loop ---------------------------------------------------------------
 

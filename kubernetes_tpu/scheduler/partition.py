@@ -15,8 +15,10 @@ node space:
   ``leaderelection.LeaderElector``'s single lease, including the
   clock-skew grace for challengers and the ``lease_renew_fail``
   injection seam;
-- pending pods are partitioned too (hash of the pod uid, overridable by
-  the spill annotation), so each pod has exactly ONE home stack and the
+- pending pods are partitioned too (hash of the pod uid -- or of the
+  GANG group key, so a pod group homes as a unit and never splits
+  across stacks -- overridable by the spill annotation), so each pod
+  has exactly ONE home stack and the
   stacks never race over fresh work -- overlap is the rare exception
   (takeover windows), resolved by typed bind conflicts, not prevented
   by global locks;
@@ -62,7 +64,13 @@ import time
 import zlib
 from typing import Dict, List, Optional, Set
 
-from kubernetes_tpu.api.types import LABEL_ZONE_KEYS, Lease, ObjectMeta, Pod
+from kubernetes_tpu.api.types import (
+    LABEL_ZONE_KEYS,
+    Lease,
+    ObjectMeta,
+    POD_GROUP_LABEL,
+    Pod,
+)
 from kubernetes_tpu.config.types import PartitionConfiguration
 from kubernetes_tpu.robustness.faults import FaultPoint, get_injector
 from kubernetes_tpu.utils import metrics
@@ -268,7 +276,15 @@ class PartitionCoordinator:
 
     def pod_partition(self, pod: Pod) -> int:
         """The pod's home partition: the spill annotation overrides the
-        uid hash (a re-stamped pod belongs to its spill target)."""
+        hash (a re-stamped pod belongs to its spill target). Gang pods
+        hash their GROUP key (namespace/pod-group label) instead of the
+        per-pod uid, so a gang homes as a unit on one stack -- a
+        uid-split gang could never reach quorum on either side and paid
+        multi-hop spill convergence to reassemble (ROADMAP item-4e).
+        The group hash is deterministic across stacks, and a spilled
+        gang member re-homes with the same annotation mechanism as any
+        pod (its siblings fail quorum on the same stack and follow to
+        the same ring successor)."""
         ann = pod.metadata.annotations.get(SPILL_TARGET_ANNOTATION)
         if ann is not None:
             try:
@@ -277,6 +293,11 @@ class PartitionCoordinator:
                     return k
             except ValueError:
                 pass
+        gang = (pod.metadata.labels or {}).get(POD_GROUP_LABEL)
+        if gang:
+            return partition_of_name(
+                f"{pod.metadata.namespace}/{gang}", self.num_partitions
+            )
         return partition_of_name(pod.metadata.uid, self.num_partitions)
 
     # -- ownership answers (event handlers, resilience, skip checks) --------

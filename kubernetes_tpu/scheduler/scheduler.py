@@ -834,6 +834,7 @@ def apply_streaming_config(
             latency_batch=st.latency_batch,
             max_batch=max_batch,
             interval_seconds=st.controller_interval_seconds,
+            auto_rungs=getattr(st, "auto_rungs", False),
         ))
 
 

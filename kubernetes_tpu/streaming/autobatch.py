@@ -23,12 +23,16 @@ Control law (deliberately simple, deterministic, and hysteretic):
 - **throughput mode** when the estimated sojourn exceeds
   ``grow_fraction * slo``: double the window toward ``max_window``
   (clamped to ``slo/2`` -- the window itself must never spend the
-  latency budget) and raise the dispatch cap to ``max_batch``.
+  latency budget) and step the dispatch cap ONE RUNG up the solve-pad
+  ladder (default ladder = the two poles, so this is "to max_batch";
+  with ``auto_rungs`` the ladder is sized from the measured per-pad
+  solve cost at warmup and calibrate() prunes rungs that don't pay).
 - **latency mode** when the estimated sojourn is under
   ``shrink_fraction * slo`` AND the queue is shallower than one
-  latency-mode batch: halve the window toward ``min_window`` and drop
-  the dispatch cap to ``latency_batch`` (which also shrinks the padded
-  solve shape -- small batches stop paying the full-pad solve cost).
+  latency-mode batch: halve the window toward ``min_window`` and step
+  the cap one rung down toward ``latency_batch`` (which also shrinks
+  the padded solve shape -- small batches stop paying the full-pad
+  solve cost).
 - **hold** inside the hysteresis band -- on a steady trace the
   controller converges and stops moving (the tier-1 oscillation guard
   pins this).
@@ -87,8 +91,18 @@ class AutoBatchController:
         pressure_ewma_alpha: float = 0.4,
         latch_after_steps: int = 2,
         unlatch_after_steps: int = 4,
+        rungs: Optional[list] = None,
+        auto_rungs: bool = False,
         now=time.monotonic,
     ) -> None:
+        """``rungs``: explicit solve-pad ladder (batch caps, ascending;
+        endpoints ``latency_batch``/``max_batch`` are always included).
+        ``auto_rungs``: seed a geometric candidate ladder between the
+        two poles instead of the hardcoded two rungs; the scheduler's
+        ``warmup()`` measures every candidate's per-pad solve cost and
+        ``calibrate`` prunes rungs that don't pay -- every surviving
+        rung is pre-compiled, so a rung switch never pays JIT mid-run
+        (ROADMAP item-2a residual)."""
         if slo_p99_seconds <= 0:
             raise ValueError("slo_p99_seconds must be positive")
         self.slo = slo_p99_seconds
@@ -106,6 +120,13 @@ class AutoBatchController:
             BATCH_BUCKET,
             BATCH_BUCKET * (lb // BATCH_BUCKET),
         )
+        # -- the solve-pad rung ladder ------------------------------------
+        self.auto_rungs = bool(auto_rungs)
+        if rungs is None and self.auto_rungs:
+            rungs = self.candidate_rungs(self.latency_batch, self.max_batch)
+        if rungs is None:
+            rungs = [self.latency_batch, self.max_batch]
+        self.rungs = self._normalize_rungs(rungs)
         self.interval = interval_seconds
         self.grow_fraction = grow_fraction
         self.shrink_fraction = shrink_fraction
@@ -138,6 +159,75 @@ class AutoBatchController:
         self.latches = 0  # times the latch engaged (visibility)
         self._over_streak = 0
         self._calm_streak = 0
+
+    # -- the solve-pad rung ladder -------------------------------------------
+
+    @staticmethod
+    def candidate_rungs(latency_batch: int, max_batch: int) -> list:
+        """Geometric candidate ladder between the two poles (doubling):
+        the starting point calibration prunes from."""
+        out = []
+        r = max(BATCH_BUCKET, int(latency_batch))
+        while r < max_batch:
+            out.append(r)
+            r *= 2
+        out.append(int(max_batch))
+        return out
+
+    def _normalize_rungs(self, rungs) -> list:
+        """Bucket-quantized, clamped, deduplicated ascending ladder that
+        always contains both poles (a cap the dispatcher never pads to
+        would fork an unwarmed jit signature)."""
+        norm = {self.latency_batch, self.max_batch}
+        for r in rungs:
+            r = int(r)
+            r = max(BATCH_BUCKET, BATCH_BUCKET * (r // BATCH_BUCKET))
+            # only strictly-interior rungs: quantizing a value at/past a
+            # pole must not mint a near-duplicate of that pole
+            if self.latency_batch < r < self.max_batch:
+                norm.add(r)
+        return sorted(norm)
+
+    def calibrate(self, pad_costs: dict, keep_fraction: float = 0.8):
+        """Prune the candidate ladder from MEASURED per-pad solve cost
+        (``BatchScheduler.warmup`` times one steady solve per compiled
+        pad): a middle rung survives only when its solve costs at most
+        ``keep_fraction`` of the next kept rung above -- a rung that
+        isn't meaningfully cheaper buys no latency and only adds
+        controller churn. The poles always survive; an unmeasured
+        middle rung drops (it was never compiled, so switching to it
+        would pay JIT mid-run -- the exact thing the ladder exists to
+        prevent). No-op unless ``auto_rungs``. Returns the ladder."""
+        if not self.auto_rungs or len(self.rungs) <= 2:
+            return self.rungs
+        kept = [self.rungs[-1]]
+        for r in reversed(self.rungs[:-1]):
+            if r == self.rungs[0]:
+                kept.append(r)
+                continue
+            cost = pad_costs.get(r)
+            above = pad_costs.get(kept[-1])
+            if cost is None or above is None or above <= 0:
+                continue
+            if cost <= keep_fraction * above:
+                kept.append(r)
+        self.rungs = sorted(set(kept))
+        if self.batch_cap not in self.rungs:
+            fitting = [r for r in self.rungs if r >= self.batch_cap]
+            self.batch_cap = fitting[0] if fitting else self.rungs[-1]
+        return self.rungs
+
+    def _cap_up(self) -> int:
+        for r in self.rungs:
+            if r > self.batch_cap:
+                return r
+        return self.rungs[-1]
+
+    def _cap_down(self) -> int:
+        for r in reversed(self.rungs):
+            if r < self.batch_cap:
+                return r
+        return self.rungs[0]
 
     # -- the control law ----------------------------------------------------
 
@@ -206,9 +296,10 @@ class AutoBatchController:
                 metrics.autobatch_latched.set(1.0)
                 # sustained overload: walking the window up one
                 # doubling per interval just prolongs the failing rung.
-                # Jump straight to the throughput pole and hold there.
+                # Jump straight to the throughput pole (top rung) and
+                # hold there.
                 return self._apply(
-                    "grow", (self.max_window, self.max_batch)
+                    "grow", (self.max_window, self.rungs[-1])
                 )
         elif pressure < self.shrink_fraction:
             self._calm_streak += 1
@@ -234,14 +325,14 @@ class AutoBatchController:
         window = min(
             self.max_window, max(self.grow_floor_window, self.window * 2.0)
         )
-        return window, self.max_batch
+        return window, self._cap_up()
 
     def _shrunk(self):
         if self.window <= self.grow_floor_window:
             window = self.min_window
         else:
             window = max(self.min_window, self.window / 2.0)
-        return window, self.latency_batch
+        return window, self._cap_down()
 
     def _apply(self, direction: str, target) -> str:
         window, cap = target

@@ -57,6 +57,83 @@ class TestPartitionHash:
         assert ks == {0, 1, 2, 3}
 
 
+class TestGangGroupHoming:
+    """ROADMAP item-4e: gang pods home by GROUP key, not per-pod uid,
+    so a gang never splits across stacks (a uid-split gang cannot reach
+    quorum on either side and pays multi-hop spill convergence)."""
+
+    def _coord(self, num_partitions=4):
+        server = APIServer()
+        return PartitionCoordinator(
+            Client(server), _FakeSched(),
+            _config(num_partitions=num_partitions), "s1",
+        )
+
+    def test_zero_cross_stack_gang_splits(self):
+        """THE regression pin: 20 gangs x 8 members with random uids
+        all home to exactly one partition per gang, and the gangs
+        themselves still spread across partitions (the group hash is a
+        real hash, not a constant)."""
+        from kubernetes_tpu.api.types import POD_GROUP_LABEL
+
+        c = self._coord(num_partitions=4)
+        homes = {}
+        for g in range(20):
+            parts = set()
+            for m in range(8):
+                pod = (
+                    make_pod(f"gang{g}-m{m}")
+                    .container(cpu="100m")
+                    .obj()
+                )
+                pod.metadata.labels[POD_GROUP_LABEL] = f"group-{g}"
+                parts.add(c.pod_partition(pod))
+            assert len(parts) == 1, (
+                f"gang group-{g} split across partitions {parts}"
+            )
+            homes[g] = parts.pop()
+        assert len(set(homes.values())) > 1, (
+            "every gang landed on one partition -- the group hash is "
+            "degenerate"
+        )
+
+    def test_group_key_is_namespaced(self):
+        from kubernetes_tpu.api.types import POD_GROUP_LABEL
+
+        c = self._coord(num_partitions=7)
+        pods = {}
+        for ns in ("team-a", "team-b"):
+            pod = make_pod("g-m0", ns).container(cpu="100m").obj()
+            pod.metadata.labels[POD_GROUP_LABEL] = "shared-name"
+            pods[ns] = c.pod_partition(pod)
+        # same label in different namespaces = different gangs: they
+        # may hash anywhere, but each must equal its own recomputation
+        for ns, k in pods.items():
+            assert k == partition_of_name("%s/shared-name" % ns, 7)
+
+    def test_non_gang_pods_keep_uid_hash(self):
+        c = self._coord(num_partitions=5)
+        pod = make_pod("plain").container(cpu="100m").obj()
+        assert c.pod_partition(pod) == partition_of_name(
+            pod.metadata.uid, 5
+        )
+
+    def test_spill_annotation_still_overrides_gang_hash(self):
+        """A spilled gang member follows its re-stamp: spill is the
+        explicit unplaceable-pod escape and must keep working for
+        gangs (siblings fail quorum on the same stack and follow to
+        the same ring successor)."""
+        from kubernetes_tpu.api.types import POD_GROUP_LABEL
+
+        c = self._coord(num_partitions=4)
+        pod = make_pod("gang-spilled").container(cpu="100m").obj()
+        pod.metadata.labels[POD_GROUP_LABEL] = "g0"
+        home = c.pod_partition(pod)
+        target = (home + 1) % 4
+        pod.metadata.annotations[SPILL_TARGET_ANNOTATION] = str(target)
+        assert c.pod_partition(pod) == target
+
+
 class TestAssignment:
     def test_covers_every_partition(self):
         a = compute_assignment(8, ["a", "b", "c"])

@@ -384,6 +384,140 @@ class TestOverloadLatch:
 # -- config wiring -----------------------------------------------------------
 
 
+class TestRungLadder:
+    """The solve-pad rung LADDER (ROADMAP item-2a residual): candidate
+    rungs between the latency and throughput poles, pruned from the
+    MEASURED per-pad solve cost at warmup, stepped through one rung per
+    controller decision."""
+
+    def test_default_stays_two_rungs(self):
+        c = AutoBatchController(latency_batch=512, max_batch=4096)
+        assert c.rungs == [512, 4096]
+        assert not c.auto_rungs
+
+    def test_auto_rungs_geometric_candidates(self):
+        c = AutoBatchController(
+            latency_batch=512, max_batch=4096, auto_rungs=True
+        )
+        assert c.rungs == [512, 1024, 2048, 4096]
+
+    def test_explicit_rungs_normalized(self):
+        c = AutoBatchController(
+            latency_batch=256, max_batch=2048,
+            rungs=[300, 1000, 9999],  # quantized, clamped, poles added
+        )
+        assert c.rungs == [256, 960, 2048]
+
+    def test_calibrate_prunes_rungs_that_dont_pay(self):
+        """A rung survives only when its measured solve is meaningfully
+        cheaper than the next kept rung above: here 2048 costs ~the
+        same as 4096 (fixed overhead dominates) and must drop, while
+        1024 and 512 pay."""
+        c = AutoBatchController(
+            latency_batch=512, max_batch=4096, auto_rungs=True
+        )
+        rungs = c.calibrate(
+            {512: 0.020, 1024: 0.040, 2048: 0.095, 4096: 0.100}
+        )
+        assert rungs == [512, 1024, 4096]
+
+    def test_calibrate_keeps_poles_and_drops_unmeasured(self):
+        c = AutoBatchController(
+            latency_batch=512, max_batch=4096, auto_rungs=True
+        )
+        # middle rungs never measured (warmup skipped them): they drop
+        # -- switching to an uncompiled pad would pay JIT mid-run
+        assert c.calibrate({512: 0.02, 4096: 0.1}) == [512, 4096]
+
+    def test_calibrate_noop_without_auto_rungs(self):
+        c = AutoBatchController(latency_batch=512, max_batch=4096)
+        assert c.calibrate({512: 0.0001, 4096: 1.0}) == [512, 4096]
+
+    def test_grow_steps_one_rung_latch_jumps_to_top(self):
+        c = AutoBatchController(
+            slo_p99_seconds=1.0, latency_batch=512, max_batch=4096,
+            rungs=[512, 1024, 2048, 4096],
+            # keep the latch out of the way for the stepping half
+            latch_after_steps=100,
+        )
+        series = [
+            (8000, 200 * (i + 1), 0.25 * (i + 1), 0.0) for i in range(2)
+        ]
+        _drive(c, series)  # step 1 primes, step 2 grows
+        assert c.batch_cap == 1024  # one rung, not a pole jump
+        _drive(
+            c,
+            [(8000, 200 * (i + 3), 0.25 * (i + 3), 0.0) for i in range(2)],
+        )
+        assert c.batch_cap == 4096  # kept walking, one rung per step
+        # latched controller pole-jumps straight to the TOP rung
+        c2 = AutoBatchController(
+            slo_p99_seconds=1.0, latency_batch=512, max_batch=4096,
+            rungs=[512, 1024, 2048, 4096], latch_after_steps=2,
+        )
+        _drive(
+            c2,
+            [(9000, 100 * (i + 1), 0.25 * (i + 1), 0.0) for i in range(6)],
+        )
+        assert c2.latched
+        assert c2.batch_cap == 4096
+
+    def test_shrink_steps_down_the_ladder(self):
+        c = AutoBatchController(
+            slo_p99_seconds=1.0, latency_batch=512, max_batch=4096,
+            rungs=[512, 1024, 2048, 4096],
+        )
+        c.batch_cap = 4096
+        c.window = c.max_window
+        # idle: shallow queue, healthy drain (step 1 primes, step 2
+        # shrinks ONE rung)
+        series = [
+            (10, 5000 * (i + 1), 0.25 * (i + 1), 0.0) for i in range(2)
+        ]
+        _drive(c, series)
+        assert c.batch_cap == 2048  # one rung down per decision
+
+    def test_attach_registers_every_rung_for_warmup(self):
+        from kubernetes_tpu.scheduler.batch import BatchScheduler
+
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(
+            client, informers, batch=True, max_batch=4096
+        )
+        try:
+            assert isinstance(sched, BatchScheduler)
+            c = AutoBatchController(
+                latency_batch=512, max_batch=4096, auto_rungs=True
+            )
+            sched.attach_autobatch(c)
+            assert {512, 1024, 2048, 4096} <= sched._warmup_pads
+        finally:
+            sched.stop()
+            informers.stop()
+
+    def test_config_auto_rungs_flag(self):
+        cfg = load_config_from_dict({
+            "tpuSolver": {"maxBatch": 1024},
+            "streaming": {
+                "enabled": True, "latencyBatch": 128, "autoRungs": True,
+            },
+        })
+        assert cfg.streaming.auto_rungs
+        assert validate_config(cfg) == []
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler_from_config(client, informers, cfg)
+        try:
+            assert sched.autobatch.auto_rungs
+            assert sched.autobatch.rungs == [128, 256, 512, 1024]
+        finally:
+            sched.stop()
+            informers.stop()
+
+
 class TestStreamingConfig:
     def test_loader_parses_streaming_block(self):
         cfg = load_config_from_dict({

@@ -32,6 +32,19 @@ Prints ONE JSON line:
    "member_full_repack_ms":
                          the RETIRED pre-PR-6 membership path (full
                          M-row repack), for scale,
+   "mesh_delta_scatter_{empty,bucket}_ms" / "mesh_full_upload_ms" /
+   "mesh_{delta,full}_link_bytes":
+                         the PR-9 mesh serving-link comparison at 20k
+                         nodes on an N-device node-axis mesh: the fixed
+                         DELTA_ROW_BUCKET shard-local scatter a steady
+                         sharded dispatch ships (empty = 0% churn,
+                         bucket = up to 64 changed rows) vs the full
+                         [N, R] upload the pre-delta mesh path paid
+                         every batch (and that >bucket churn still
+                         escalates to); link_bytes is the payload each
+                         variant ships -- the quantity that costs on a
+                         tunneled serving link (on a CPU host the
+                         "link" is a memcpy: read the bytes ratio),
    "watch_fanout_{perevent,bulk}_{1,4}w_ms":
                          apiserver watch fan-out: 20k pod events
                          broadcast to 1 vs 4 concurrent watchers,
@@ -344,6 +357,131 @@ def bench_membership_churn(num_nodes, churn_fraction=0.05):
     return out
 
 
+def bench_mesh_delta(num_nodes: int, mesh_devices: int):
+    """The PR-9 mesh serving-link comparison: what a steady-state
+    sharded dispatch ships (the fixed DELTA_ROW_BUCKET per-shard delta
+    scatter, applied shard-locally onto the device-resident carry)
+    vs what the pre-delta mesh path shipped every batch (a counted full
+    [N, R] + [N, 2] node-state upload) at ``num_nodes`` scale.
+
+    Both paths mirror the dispatch exactly: concatenate the variant's
+    node-state pieces into the (replicated) upload buffer, ship it, and
+    commit it to the node-sharded resident state inside one jit -- the
+    delta variant scatters its DELTA_ROW_BUCKET slots shard-locally,
+    the full variant reshards the uploaded [N, R]+[N, 2] to the node
+    sharding (what the pre-delta mesh path, and >bucket churn today,
+    pays every batch). Churn mapping at 20k nodes: 0% ships the EMPTY
+    bucket, anything up to 64 rows ships the same fixed bucket, and
+    both the 1% and 100% rungs of the node-state microbench exceed the
+    bucket and escalate to exactly the measured full upload.
+    ``*_link_bytes`` is the serving-link payload each variant ships --
+    on the tunneled chip (~40-90ms/round trip + bandwidth) that is the
+    quantity the delta path exists to cut; on a CPU host the "link" is
+    a memcpy, so read the bytes ratio there, not wall-clock. Medians
+    over repeats; both paths end device-committed."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from kubernetes_tpu.ops.assignment import shard_local_row_set
+    from kubernetes_tpu.scheduler.batch import DELTA_ROW_BUCKET
+
+    devs = jax.devices()
+    n_dev = max(1, min(mesh_devices, len(devs)))
+    mesh = Mesh(np.array(devs[:n_dev]), ("nodes",))
+    node2d = NamedSharding(mesh, P("nodes", None))
+    repl = NamedSharding(mesh, P())
+    # bucket-pad like NodeTensorCache (128 rows), then to the mesh size
+    n = 128 * ((num_nodes + 127) // 128)
+    n = n_dev * ((n + n_dev - 1) // n_dev)
+    r = 10  # fixed dims + a few scalar/encoding columns (bench shape)
+    rng = np.random.default_rng(0)
+    req_host = rng.integers(0, 1 << 20, size=(n, r), dtype=np.int32)
+    nzr_host = rng.integers(0, 1 << 20, size=(n, 2), dtype=np.int32)
+    req_dev = jax.device_put(req_host, node2d)
+    nzr_dev = jax.device_put(nzr_host, node2d)
+    jax.block_until_ready((req_dev, nzr_dev))
+    k = DELTA_ROW_BUCKET
+
+    @jax.jit
+    def apply_delta(req, nzr, buf):
+        didx = buf[:k]
+        dreq = buf[k:k + k * r].reshape(k, r)
+        dnzr = buf[k + k * r:].reshape(k, 2)
+        return (
+            shard_local_row_set(req, didx, dreq),
+            shard_local_row_set(nzr, didx, dnzr),
+        )
+
+    @jax.jit
+    def apply_full(buf):
+        req = buf[:n * r].reshape(n, r)
+        nzr = buf[n * r:].reshape(n, 2)
+        return (
+            jax.lax.with_sharding_constraint(req, node2d),
+            jax.lax.with_sharding_constraint(nzr, node2d),
+        )
+
+    def run_delta(rows: int):
+        didx = np.full(k, n, dtype=np.int32)
+        if rows:
+            didx[:rows] = rng.choice(n, size=rows, replace=False)
+        dreq = np.zeros((k, r), dtype=np.int32)
+        dnzr = np.zeros((k, 2), dtype=np.int32)
+
+        def once():
+            buf = np.concatenate(
+                [didx.ravel(), dreq.ravel(), dnzr.ravel()]
+            )
+            out = apply_delta(
+                req_dev, nzr_dev, jax.device_put(buf, repl)
+            )
+            jax.block_until_ready(out)
+            return buf.nbytes
+
+        nbytes = once()  # warm (compile)
+        samples = []
+        for _ in range(15):
+            t0 = time.perf_counter()
+            once()
+            samples.append((time.perf_counter() - t0) * 1000)
+        return sorted(samples)[len(samples) // 2], nbytes
+
+    def run_full():
+        def once():
+            buf = np.concatenate([req_host.ravel(), nzr_host.ravel()])
+            out = apply_full(jax.device_put(buf, repl))
+            jax.block_until_ready(out)
+            return buf.nbytes
+
+        nbytes = once()  # warm (compile)
+        samples = []
+        for _ in range(15):
+            t0 = time.perf_counter()
+            once()
+            samples.append((time.perf_counter() - t0) * 1000)
+        return sorted(samples)[len(samples) // 2], nbytes
+
+    empty_ms, delta_bytes = run_delta(0)
+    bucket_ms, _ = run_delta(k)
+    full_ms, full_bytes = run_full()
+    return {
+        "mesh_devices": n_dev,
+        "mesh_nodes": n,
+        "mesh_delta_rows_bucket": k,
+        "mesh_delta_scatter_empty_ms": empty_ms,
+        "mesh_delta_scatter_bucket_ms": bucket_ms,
+        "mesh_full_upload_ms": full_ms,
+        "mesh_delta_link_bytes": int(delta_bytes),
+        "mesh_full_link_bytes": int(full_bytes),
+        "mesh_full_vs_delta_ms_x": (
+            round(full_ms / bucket_ms, 1) if bucket_ms > 0 else 0.0
+        ),
+        "mesh_full_vs_delta_bytes_x": (
+            round(full_bytes / delta_bytes, 1) if delta_bytes else 0.0
+        ),
+    }
+
+
 def bench_watch_fanout(events: int = 20000):
     """Apiserver watch fan-out under N consumers (the partitioned
     control plane runs one full informer set PER STACK): broadcast
@@ -416,7 +554,34 @@ def main() -> None:
         "--batch", type=int, default=4096,
         help="pop_batch size for the queue drain (bench.py default)",
     )
+    ap.add_argument(
+        "--mesh-devices", type=int, default=0,
+        help="node-axis mesh size for the mesh delta microbench. "
+             "Default 0 = use the devices the process already has "
+             "(mesh of 1 on a plain CPU box). An EXPLICIT N > 1 on a "
+             "CPU box force-splits the host platform into N virtual "
+             "devices -- which changes the jax backend under EVERY "
+             "microbench in this process, so the historical series "
+             "for the single-device numbers only compares against "
+             "runs with the same flag",
+    )
+    ap.add_argument(
+        "--mesh-nodes", type=int, default=20000,
+        help="node count for the mesh delta microbench",
+    )
     args = ap.parse_args()
+
+    # must land before the first jax import below (the kubernetes_tpu
+    # imports inside the bench functions pull jax in); opt-in only --
+    # see the --mesh-devices help text
+    if args.mesh_devices > 1 and (
+        "xla_force_host_platform_device_count"
+        not in os.environ.get("XLA_FLAGS", "")
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.mesh_devices}"
+        ).strip()
 
     from kubernetes_tpu.testing import make_pod
 
@@ -437,6 +602,7 @@ def main() -> None:
     gather_ms, assume_ms = bench_commit(pods, node_names)
     node_state = bench_node_state(args.nodes)
     member = bench_membership_churn(args.nodes)
+    mesh_delta = bench_mesh_delta(args.mesh_nodes, args.mesh_devices)
     fanout = bench_watch_fanout()
 
     record = {
@@ -460,6 +626,12 @@ def main() -> None:
         {
             k: (v if isinstance(v, int) else round(v, 3))
             for k, v in member.items()
+        }
+    )
+    record.update(
+        {
+            k: (v if isinstance(v, int) else round(v, 3))
+            for k, v in mesh_delta.items()
         }
     )
     record.update({k: round(v, 2) for k, v in fanout.items()})
