@@ -374,7 +374,193 @@ def _lifecycle_setup(
     raise ValueError(f"unknown lifecycle mode {mode!r}")
 
 
+def run_partition_workload(
+    wl: Dict[str, Any], defaults: Dict[str, Any]
+) -> Dict[str, Any]:
+    """A perf-matrix workload through N ACTIVE partitioned stacks
+    (scheduler/partition.py) instead of one scheduler -- the matrix
+    shape of ``bench.py --partitions``, here so partition modes get
+    standing rows (ROADMAP item-4d: zone-aligned partitioning was
+    wired but had no perf-matrix number). Workload key::
+
+        partitions: {count: 2, zone_aligned: true}
+
+    With ``zone_aligned`` the node space splits by the zone label
+    (crc32 over the zone instead of the node name), so a whole zone
+    homes on -- and fails over with -- one partition; the workload's
+    ``zones`` count therefore bounds the useful partition count. The
+    result rows carry the conflict ledger (absorbed == requeues +
+    stale, the PR-8 tier-1 invariant) and the spill count next to the
+    throughput so an imbalanced or conflict-heavy run is visible in
+    the matrix, not just slow."""
+    from kubernetes_tpu.config.types import (
+        KubeSchedulerConfiguration,
+        PartitionConfiguration,
+    )
+    from kubernetes_tpu.scheduler.app import SchedulerApp
+
+    name = wl["name"]
+    num_nodes = int(wl["nodes"])
+    zones = int(wl.get("zones", defaults.get("zones", 10)))
+    max_batch = int(wl.get("max_batch", defaults.get("max_batch", 1024)))
+    timeout_s = float(wl.get("timeout_s", defaults.get("timeout_s", 420)))
+    node_spec = wl.get("node") or {}
+    pt = wl["partitions"]
+    n_parts = int(pt.get("count", 2))
+    zone_aligned = bool(pt.get("zone_aligned", False))
+
+    server = APIServer()
+
+    def cfg():
+        c = KubeSchedulerConfiguration(
+            partition=PartitionConfiguration(
+                enabled=True,
+                num_partitions=n_parts,
+                zone_aligned=zone_aligned,
+                # generous leases: the measured burst saturates the box,
+                # and a starved renew thread mid-burst would turn the
+                # row into a takeover storm (bench.py --partitions
+                # rationale); takeover latency has its own chaos harness
+                lease_duration_seconds=10.0,
+                retry_period_seconds=1.0,
+            )
+        )
+        c.tpu_solver.max_batch = max_batch
+        return c
+
+    apps = []
+    coll = None
+    try:
+        apps = [
+            SchedulerApp(config=cfg(), server=server)
+            for _ in range(n_parts)
+        ]
+        client = apps[0].client
+        for i in range(num_nodes):
+            nw = make_node(f"node-{i}").capacity(
+                cpu=str(node_spec.get("cpu", defaults.get("node_cpu", "32"))),
+                memory=str(
+                    node_spec.get("memory", defaults.get("node_memory", "64Gi"))
+                ),
+                pods=int(node_spec.get("pods", defaults.get("node_pods", 110))),
+            )
+            nw.label(ZONE_LABEL, f"zone-{i % zones}")
+            nw.label(HOSTNAME_LABEL, f"node-{i}")
+            client.create_node(nw.obj())
+        for app in apps:
+            app.sched.max_batch = max_batch
+        for app in apps:
+            app.start()
+        # settle: every partition claimed by exactly one stack. A claim
+        # that never lands would otherwise surface 900s later as an
+        # opaque bind timeout (pods homed to the unclaimed partition
+        # sit forever), so an unsettled map is an explicit error row.
+        deadline = time.time() + 15
+        held: List[int] = []
+        while time.time() < deadline:
+            held = sorted(
+                k for app in apps for k in app.coordinator.held_partitions()
+            )
+            if held == list(range(n_parts)):
+                break
+            time.sleep(0.05)
+        if held != list(range(n_parts)):
+            return {
+                "name": name,
+                "error": (
+                    f"partition map never settled: held {held} of "
+                    f"{n_parts} partitions after 15s"
+                ),
+            }
+        # warmup AFTER start+settle: app.start() is what syncs the
+        # informers, and each stack's cache scopes to its held
+        # partitions -- warming earlier sees zero nodes and compiles
+        # nothing (the measured burst would then pay the JIT). jit
+        # caches are process-global and the stacks' ~N/P node tensors
+        # bucket-pad to the same capacity, so one warmup covers every
+        # stack
+        apps[0].sched.warmup()
+
+        init_n = int(wl.get("init_pods", 0))
+        init_spec = wl.get("init_pod") or wl.get("pod") or {}
+        if init_n:
+            init_names = [f"init-{i}" for i in range(init_n)]
+            icoll = BindCollector(server, init_names)
+            for i, nm in enumerate(init_names):
+                client.create_pod(_build_pod(nm, init_spec, i))
+            if not icoll.wait(timeout_s):
+                icoll.stop()
+                return {"name": name, "error": "init pods did not all schedule"}
+            icoll.stop()
+
+        measure_pods = int(wl["measure_pods"])
+        pod_spec = wl.get("pod") or {}
+        pods = [
+            _build_pod(f"measure-{i}", pod_spec, i)
+            for i in range(measure_pods)
+        ]
+        target_names = [p.metadata.name for p in pods]
+        coll = BindCollector(server, target_names)
+        create_times: Dict[str, float] = {}
+        start = time.perf_counter()
+        for p in pods:
+            create_times[p.metadata.name] = time.perf_counter()
+            client.create_pod(p)
+        ok = coll.wait(timeout_s)
+        elapsed = time.perf_counter() - start
+        for app in apps:
+            app.sched.wait_for_inflight_binds(timeout=60)
+
+        bound = sum(1 for n in target_names if n in coll.bind_times)
+        result: Dict[str, Any] = {
+            "name": name,
+            "ok": bool(ok and bound >= measure_pods),
+            "bound": bound,
+            "total": measure_pods,
+            "elapsed_s": round(elapsed, 3),
+            "throughput_pods_per_s": (
+                round(bound / elapsed, 1) if elapsed else 0.0
+            ),
+        }
+        lat = sorted(
+            coll.bind_times[n] - create_times[n]
+            for n in target_names
+            if n in coll.bind_times and n in create_times
+        )
+        if lat:
+            result["latency_ms"] = {
+                "Perc50": round(_percentile(lat, 50) * 1000, 1),
+                "Perc90": round(_percentile(lat, 90) * 1000, 1),
+                "Perc99": round(_percentile(lat, 99) * 1000, 1),
+            }
+        absorbed = sum(a.sched.bind_conflicts_absorbed for a in apps)
+        requeues = sum(a.sched.conflict_requeues for a in apps)
+        stale = sum(a.sched.conflict_stale_binds for a in apps)
+        result["partition"] = {
+            "count": n_parts,
+            "zone_aligned": zone_aligned,
+            "bind_conflicts_absorbed": absorbed,
+            "conflict_requeues": requeues,
+            "conflict_stale_binds": stale,
+            "ledger_balanced": absorbed == requeues + stale,
+            "pods_spilled": sum(a.sched.pods_spilled for a in apps),
+            "takeovers": sum(a.coordinator.takeovers for a in apps),
+            "pods_fallback": sum(a.sched.pods_fallback for a in apps),
+        }
+        return result
+    finally:
+        if coll is not None:
+            coll.stop()
+        for app in apps:
+            try:
+                app.stop()
+            except Exception:  # noqa: BLE001 - teardown keeps going
+                pass
+
+
 def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]:
+    if wl.get("partitions"):
+        return run_partition_workload(wl, defaults)
     name = wl["name"]
     num_nodes = int(wl["nodes"])
     zones = int(wl.get("zones", defaults.get("zones", 10)))
@@ -659,6 +845,7 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
 
         # -- measured burst -------------------------------------------------------
         pod_spec = wl.get("pod") or {}
+        selector_mix = int(wl.get("selector_mix", 0))
         pods = []
         for i in range(measure_pods):
             spec_i = pod_spec
@@ -669,6 +856,17 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
                 spec_i = dict(pod_spec)
                 spec_i["node_selector"] = {
                     HOSTNAME_LABEL: f"node-{i % num_nodes}"
+                }
+            elif selector_mix:
+                # mask-diversity mix: pods rotate through selector_mix
+                # distinct zone nodeSelectors, so every batch carries
+                # ~selector_mix deduplicated [U, N] static-mask rows --
+                # at the 100k-node mesh tier that is exactly the
+                # payload the sharded (column-split, bool) mask upload
+                # exists to cut (PR 10)
+                spec_i = dict(pod_spec)
+                spec_i["node_selector"] = {
+                    ZONE_LABEL: f"zone-{i % selector_mix}"
                 }
             p = _build_pod(f"measure-{i}", spec_i, i)
             if gang:
@@ -901,6 +1099,11 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
             }
         result["solver"] = {
             "mesh_devices": mesh_devices,
+            # which mesh tier the workload ACTUALLY solved on:
+            # "pallas" = the shard_map'd per-shard tier (PR 10),
+            # "xla" = the GSPMD twin (KTPU_MESH_PALLAS=0, ineligible
+            # shape, or breaker-routed fallback), "" = no mesh
+            "mesh_tier": getattr(sched, "mesh_solver_tier", ""),
             "batches": sched.batches_solved,
             "pods_on_device": sched.pods_solved_on_device,
             "pods_fallback": sched.pods_fallback,
@@ -988,6 +1191,12 @@ def to_data_items(results: List[Dict[str, Any]]) -> Dict[str, Any]:
             {
                 f"streaming_{k}": str(v)
                 for k, v in (r.get("streaming") or {}).items()
+            }
+        )
+        labels.update(
+            {
+                f"partition_{k}": str(v)
+                for k, v in (r.get("partition") or {}).items()
             }
         )
         if r.get("error") or not r.get("ok", False):

@@ -507,6 +507,148 @@ def _packed_solve_tail(
     return assignment, req_out, nzr_out, alloc, valid
 
 
+#: ship the [U, N] mask rows as their own column-sharded bool operand
+#: only when the REPLICATED int32 payload (u * n * 4 * P bytes, what
+#: the in-buffer form costs across the mesh) exceeds this -- below it,
+#: the extra device_put's per-operand link round trip (~40-90ms on a
+#: tunneled chip) outweighs the byte saving and the rows stay in the
+#: single replicated buffer
+MESH_MASK_SHARD_MIN_BYTES = int(
+    _os.environ.get("KTPU_MESH_MASK_SHARD_MIN_BYTES", 1 << 20)
+)
+
+
+def mesh_pallas_candidate(mode: str, n_cap: int, mesh) -> bool:
+    """Whether the mesh dispatch would run the shard_map'd Pallas tier
+    for this (mode, shape): greedy batches only (the constrained and
+    sinkhorn modes stay on the GSPMD twin), ``KTPU_MESH_PALLAS=0`` pins
+    the twin-only behavior, and shard_map needs the node axis to split
+    evenly over the mesh (NodeTensorCache pads to 128 rows, so any
+    power-of-two mesh divides; a ragged capacity falls back to the
+    twin instead of failing the shard_map trace). Shared with the
+    degradation ladder (scheduler/batch.py ``_device_tiers``) so a
+    shape that would never run the sharded kernel never gets a
+    'pallas' tier attempt."""
+    if mesh is None or "nodes" not in mesh.axis_names:
+        return False
+    p = int(mesh.devices.size)
+    return (
+        mode == "greedy"
+        and _os.environ.get("KTPU_MESH_PALLAS", "1") != "0"
+        and p > 1
+        and n_cap % p == 0
+    )
+
+
+def _mesh_shard_solver(mesh, config: GreedyConfig, use_kernel: bool):
+    """The shard_map'd solver tail (the mesh's Pallas tier): each device
+    runs the whole-array greedy step on its OWN ``[N/P, R]`` shard of
+    the resident carry, and the per-pod argmax reduces across shards
+    with one psum-style best-of-shards combine -- a pmax of the shard
+    best scores plus a pmin of the winning global index -- instead of
+    the GSPMD twin's per-step full-score gather. Placement parity with
+    the sequential oracle is exact: the per-shard arithmetic is the
+    same elementwise fit/score math, and (max score, lowest global
+    index) over shard-local (max, lowest-local-index) candidates equals
+    the global argmax's lowest-index tie-break because shard i's global
+    indices all precede shard i+1's.
+
+    ``use_kernel`` routes the shard-local step through the fused Pallas
+    candidate kernel (ops/pallas_solver.pallas_shard_candidate) on TPU
+    backends -- one kernel call per step instead of the ~10-op XLA
+    lowering -- and through the bit-identical jnp formulation
+    elsewhere (CPU meshes: the win is the scalar combine replacing the
+    per-step [N] gather)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    big = jnp.int32(1 << 30)
+
+    def body(alloc, req, nzr, valid, preq, pnzr, rows, midx, act):
+        n_loc = alloc.shape[0]
+        p_idx = jax.lax.axis_index("nodes")
+        offset = (p_idx * n_loc).astype(jnp.int32)
+        node_iota = jnp.arange(n_loc, dtype=jnp.int32)
+        gidx_iota = node_iota + offset
+
+        def combine(lbest, lidx, is_active):
+            """The best-of-shards combine: max score, then lowest
+            global node index among the shards holding it. Returns
+            (assignment, chosen): the winner's bump (``chosen``) lands
+            on exactly one shard's local rows."""
+            gbest = jax.lax.pmax(lbest, "nodes")
+            gidx = jax.lax.pmin(
+                jnp.where(lbest == gbest, lidx, big), "nodes"
+            )
+            placed = (gbest > -jnp.inf) & is_active
+            assignment = jnp.where(placed, gidx, NO_NODE).astype(jnp.int32)
+            chosen = (gidx_iota == gidx) & placed
+            return assignment, chosen
+
+        if use_kernel:
+            from kubernetes_tpu.ops.pallas_solver import (
+                pallas_shard_candidate,
+            )
+
+            alloc_t = alloc.T
+            valid_row = valid.astype(jnp.int32)[None, :]
+            rows_i = rows.astype(jnp.int32)
+
+            def step(carry, inputs):
+                req_t, nzr_t = carry  # transposed [R, n_loc] / [2, n_loc]
+                p_req, p_nzr, mi, is_active = inputs
+                lbest, llocal = pallas_shard_candidate(
+                    alloc_t, req_t, nzr_t, valid_row, rows_i,
+                    p_req, p_nzr, mi, config=config,
+                )
+                assignment, chosen = combine(
+                    lbest, llocal + offset, is_active
+                )
+                req_t = req_t + chosen[None, :] * p_req[:, None]
+                nzr_t = nzr_t + chosen[None, :] * p_nzr[:, None]
+                return (req_t, nzr_t), assignment
+
+            (req_t, nzr_t), assignments = jax.lax.scan(
+                step, (req.T, nzr.T), (preq, pnzr, midx, act),
+                unroll=SCAN_UNROLL,
+            )
+            return assignments, req_t.T, nzr_t.T
+
+        caps = alloc[:, :2]
+
+        def step(carry, inputs):
+            req_state, nzr_state = carry
+            p_req, p_nzr, mi, is_active = inputs
+            free = alloc - req_state
+            fits = _fits(free, p_req)
+            feasible = fits & rows[mi] & valid
+            score = _combined_score(caps, nzr_state, p_nzr, config)
+            masked = jnp.where(feasible, score, -jnp.inf)
+            lbest = jnp.max(masked)
+            lidx = jnp.min(jnp.where(masked == lbest, gidx_iota, big))
+            assignment, chosen = combine(lbest, lidx, is_active)
+            req_state = req_state + chosen[:, None] * p_req[None, :]
+            nzr_state = nzr_state + chosen[:, None] * p_nzr[None, :]
+            return (req_state, nzr_state), assignment
+
+        (req_out, nzr_out), assignments = jax.lax.scan(
+            step, (req, nzr), (preq, pnzr, midx, act),
+            unroll=SCAN_UNROLL,
+        )
+        return assignments, req_out, nzr_out
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P("nodes", None), P("nodes", None), P("nodes", None),
+            P("nodes"), P(), P(), P(None, "nodes"), P(), P(),
+        ),
+        out_specs=(P(), P("nodes", None), P("nodes", None)),
+        check_rep=False,
+    )
+
+
 #: one jitted sharded twin per Mesh (BatchScheduler holds one mesh for
 #: its lifetime; tests/benches may build a few)
 _MESH_PACKED_JIT: dict = {}
@@ -520,9 +662,24 @@ def make_mesh_packed_solver(mesh: "jax.sharding.Mesh"):
     locally (``shard_local_row_set``). Output shardings are pinned so
     one step's carry feeds the next step's inputs with no resharding
     (SNIPPETS.md pjit guidance: ``out_axis_resources`` of step k ==
-    ``in_axis_resources`` of step k+1). One jitted instance per mesh,
-    cached -- its signature count is observable via
-    ``mesh_packed_cache_size`` (the dryrun's zero-recompile probe)."""
+    ``in_axis_resources`` of step k+1).
+
+    The ``[U, N]`` static-mask rows leave the replicated buffer above
+    ``MESH_MASK_SHARD_MIN_BYTES``: they arrive as their own bool
+    operand already device_put COLUMN-sharded over the node axis
+    (``solve_packed``), so each shard's host->device link carries only
+    its ``[U, N/P]`` mask columns instead of the full replicated rows;
+    below the cutoff (small clusters, where a second link round trip
+    costs more than the bytes save) ``rows_in`` is None and the rows
+    ride the buffer as before.
+
+    ``use_pallas=True`` routes greedy batches through the shard_map'd
+    Pallas tier (``_mesh_shard_solver``): each device runs the fused
+    whole-array step on its own carry shard with a single
+    best-of-shards combine per pod. One jitted instance per mesh,
+    cached -- its signature count (BOTH tiers' layouts) is observable
+    via ``mesh_packed_cache_size`` (the dryrun's zero-recompile
+    probe)."""
     fn = _MESH_PACKED_JIT.get(mesh)
     if fn is not None:
         return fn
@@ -532,10 +689,12 @@ def make_mesh_packed_solver(mesh: "jax.sharding.Mesh"):
     node2d = NamedSharding(mesh, P("nodes", None))
     rows_sh = NamedSharding(mesh, P(None, "nodes"))
 
-    @partial(jax.jit, static_argnames=("layout", "config", "mode"))
+    @partial(
+        jax.jit, static_argnames=("layout", "config", "mode", "use_pallas")
+    )
     def solve(
-        buf, alloc_in, valid_in, req_in, nzr_in, layout,
-        config=GreedyConfig(), mode="greedy",
+        buf, rows_in, alloc_in, valid_in, req_in, nzr_in, layout,
+        config=GreedyConfig(), mode="greedy", use_pallas=False,
     ):
         arrs = _unpack_buffer(buf, layout)
         alloc = arrs["alloc"] if "alloc" in arrs else alloc_in
@@ -552,13 +711,30 @@ def make_mesh_packed_solver(mesh: "jax.sharding.Mesh"):
         valid = jax.lax.with_sharding_constraint(valid, node)
         req_state = jax.lax.with_sharding_constraint(req_state, node2d)
         nzr_state = jax.lax.with_sharding_constraint(nzr_state, node2d)
+        # below the MESH_MASK_SHARD_MIN_BYTES cutoff the rows rode the
+        # replicated buffer (rows_in is None); above it they arrive as
+        # their own column-sharded bool operand
+        rows_arr = arrs["rows"] if rows_in is None else rows_in
         arrs["rows"] = jax.lax.with_sharding_constraint(
-            arrs["rows"], rows_sh
+            rows_arr.astype(bool), rows_sh
         )
-        assignment, req_out, nzr_out, alloc, valid = _packed_solve_tail(
-            arrs, alloc, valid, req_state, nzr_state, config, mode,
-            use_pallas=False, caps=None,
-        )
+        if use_pallas and mode == "greedy":
+            solver = _mesh_shard_solver(
+                mesh, config,
+                use_kernel=jax.default_backend() == "tpu",
+            )
+            assignment, req_out, nzr_out = solver(
+                alloc, req_state, nzr_state, valid,
+                arrs["req"], arrs["nzr"], arrs["rows"], arrs["midx"],
+                arrs["active"].astype(bool),
+            )
+        else:
+            assignment, req_out, nzr_out, alloc, valid = (
+                _packed_solve_tail(
+                    arrs, alloc, valid, req_state, nzr_state, config,
+                    mode, use_pallas=False, caps=None,
+                )
+            )
         req_out = jax.lax.with_sharding_constraint(req_out, node2d)
         nzr_out = jax.lax.with_sharding_constraint(nzr_out, node2d)
         return assignment, req_out, nzr_out, alloc, valid
@@ -757,8 +933,14 @@ def solve_packed(
     ``mesh``: a ``jax.sharding.Mesh`` with a "nodes" axis routes the
     solve through the sharded twin (``make_mesh_packed_solver``): the
     batch buffer uploads replicated, the resident node state stays
-    sharded over the node axis, and the Pallas kernels (whole-array
-    single-core programs) are never attempted."""
+    sharded over the node axis, and the ``[U, N]`` static-mask rows
+    ship as their own bool operand COLUMN-sharded host-side (each
+    shard uploads only its ``[U, N/P]`` columns -- at the 100k-node
+    tier the replicated int32 rows were the dominant link payload).
+    Greedy mesh batches additionally run the shard_map'd Pallas tier
+    (``mesh_pallas_candidate``) unless ``allow_pallas`` is False (the
+    ladder's xla tier) -- the single-core whole-array kernels
+    themselves are still never attempted on a mesh."""
     import numpy as _np
 
     layout = tuple(
@@ -803,6 +985,52 @@ def solve_packed(
             return arr
         return arr.astype(_np.int32)
 
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # the [U, N] static-mask rows ship OUTSIDE the replicated
+        # buffer, as a bool array column-sharded over the node axis:
+        # each shard's link carries [U, N/P] bytes instead of the
+        # replicated 4-byte int32 rows (the next link cost at the
+        # 100k-node tier). BUT only when the replicated payload is big
+        # enough to pay for it: over a tunneled serving link every
+        # extra device_put OPERAND costs its own ~40-90ms round trip
+        # (the whole reason the single-buffer design exists), so small
+        # clusters keep the rows inside the buffer and only
+        # above-threshold payloads ship the second, sharded operand.
+        # The decision is a pure shape function, so warmup and
+        # dispatch always agree and each side keeps ONE jit signature
+        # per U bucket.
+        rows_host = next(arr for name, arr in pieces if name == "rows")
+        p = int(mesh.devices.size)
+        shard_rows = (
+            rows_host.size * 4 * p > MESH_MASK_SHARD_MIN_BYTES
+        )
+        if shard_rows:
+            rows_d = jax.device_put(
+                _np.ascontiguousarray(rows_host, dtype=bool),
+                NamedSharding(mesh, P(None, "nodes")),
+            )
+            mesh_layout = tuple(e for e in layout if e[0] != "rows")
+        else:
+            rows_d = None
+            mesh_layout = layout
+        buf = _np.concatenate(
+            [
+                as_i32(arr).ravel()
+                for name, arr in pieces
+                if not (shard_rows and name == "rows")
+                and not isinstance(arr, ConstPiece)
+            ]
+        )
+        buf_d = jax.device_put(buf, NamedSharding(mesh, P()))
+        return make_mesh_packed_solver(mesh)(
+            buf_d, rows_d, alloc_in, valid_in, req_in, nzr_in,
+            layout=mesh_layout, config=config, mode=mode,
+            use_pallas=(
+                allow_pallas and mesh_pallas_candidate(mode, n_cap, mesh)
+            ),
+        )
     buf = _np.concatenate(
         [
             as_i32(arr).ravel()
@@ -810,14 +1038,6 @@ def solve_packed(
             if not isinstance(arr, ConstPiece)
         ]
     )
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        buf_d = jax.device_put(buf, NamedSharding(mesh, P()))
-        return make_mesh_packed_solver(mesh)(
-            buf_d, alloc_in, valid_in, req_in, nzr_in,
-            layout=layout, config=config, mode=mode,
-        )
     buf_d = jax.device_put(buf)
     try:
         return _solve_packed_jit(

@@ -158,3 +158,21 @@ def static_mask(
     """[B, capacity] bool: label-level feasibility per (pod, node)."""
     rows, index = static_mask_compact(pods, snapshot, nt)
     return rows[index]
+
+
+def mask_rows_upload(rows: np.ndarray, mesh=None) -> np.ndarray:
+    """The ``[U, N]`` mask rows in their upload form. Single-device
+    dispatch concatenates them into the int32 single-buffer upload
+    (ops/assignment.solve_packed), so they convert to int32 here. On a
+    MESH the rows ship as a bool piece: above
+    ``assignment.MESH_MASK_SHARD_MIN_BYTES`` ``solve_packed`` pulls
+    them out of the replicated buffer and device_puts them COLUMN-
+    sharded over the node axis -- each shard's host->device link then
+    carries only its ``[U, N/P]`` 1-byte columns instead of the full
+    replicated 4-byte rows, the same routing the delta-scatter slots
+    get (below the cutoff they stay in the buffer: the extra
+    per-operand link round trip would cost more than the bytes
+    save)."""
+    if mesh is not None:
+        return np.ascontiguousarray(rows, dtype=bool)
+    return rows.astype(np.int32)

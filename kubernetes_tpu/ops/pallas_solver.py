@@ -36,6 +36,90 @@ from kubernetes_tpu.tensors.node_tensor import NUM_FIXED_DIMS, PODS
 _BIG = 1 << 30  # python int: jnp scalars at module scope become captured consts
 
 
+def _step_fit_score_argmax(
+    alloc, caps, cap_safe, valid, col, smask,
+    req_state, nzr_state, req_scalar, p0, p1,
+    *,
+    r: int,
+    w_least: int,
+    w_balanced: int,
+    w_most: int,
+):
+    """One pod step's fused fit + score + masked lowest-index argmax
+    over ``[*, N]`` transposed node state -- THE shared step arithmetic
+    of ``_solver_kernel`` (whole-batch single-core kernel) and
+    ``_shard_candidate_kernel`` (the mesh tier's per-shard step): one
+    body, so the bit-parity contract with
+    ``assignment._greedy_assign_impl`` (same fit short-circuit rules,
+    same scorer arithmetic with the f32 epsilon floors, same
+    lowest-index tie-break) has a single place to hold.
+    ``req_scalar(d)`` reads the pod's d-th request scalar from the
+    caller's SMEM layout; ``p0``/``p1`` are the pod's non-zero-request
+    scalars already cast to f32. Returns
+    (feasible [1, N], best_score [], choice_col [])."""
+    n = alloc.shape[1]
+    free = alloc - req_state  # [R, N]
+
+    # -- fit (assignment._fits semantics) -------------------------------
+    fits_all = None
+    fits_pods = None
+    all_zero = None
+    for d in range(r):
+        s = req_scalar(d)
+        ok = s <= free[d:d + 1, :]  # [1, N]
+        if d >= NUM_FIXED_DIMS:
+            ok = ok | (s == 0)
+        fits_all = ok if fits_all is None else (fits_all & ok)
+        if d == PODS:
+            fits_pods = ok
+        else:
+            zero_d = s == 0
+            all_zero = zero_d if all_zero is None else (all_zero & zero_d)
+    # Mosaic can't select between i1 vectors: route through int32
+    fits = jnp.where(
+        all_zero,
+        fits_pods.astype(jnp.int32),
+        fits_all.astype(jnp.int32),
+    ) > 0  # [1, N]
+    feasible = fits & smask & valid
+
+    # -- score (ops/scores.py arithmetic, transposed) -------------------
+    req_tot = nzr_state.astype(jnp.float32) + jnp.concatenate(
+        [
+            jnp.full((1, n), 0.0, jnp.float32) + p0,
+            jnp.full((1, n), 0.0, jnp.float32) + p1,
+        ],
+        axis=0,
+    )  # [2, N]
+    score = jnp.zeros((1, n), dtype=jnp.float32)
+    if w_least:
+        raw = jnp.floor((caps - req_tot) * MAX_NODE_SCORE / cap_safe + _EPS)
+        per_dim = jnp.where((caps == 0) | (req_tot > caps), 0.0, raw)
+        score += w_least * jnp.floor(
+            jnp.sum(per_dim, axis=0)[None] / 2.0 + _EPS
+        )
+    if w_balanced:
+        frac = jnp.where(caps == 0, 1.0, req_tot / cap_safe)
+        diff = jnp.abs(frac[0:1, :] - frac[1:2, :])
+        ba = jnp.trunc((1.0 - diff) * MAX_NODE_SCORE + _EPS)
+        ba = jnp.where(
+            (frac[0:1, :] >= 1.0) | (frac[1:2, :] >= 1.0), 0.0, ba
+        )
+        score += w_balanced * ba
+    if w_most:
+        raw = jnp.floor(req_tot * MAX_NODE_SCORE / cap_safe + _EPS)
+        per_dim = jnp.where((caps == 0) | (req_tot > caps), 0.0, raw)
+        score += w_most * jnp.floor(
+            jnp.sum(per_dim, axis=0)[None] / 2.0 + _EPS
+        )
+
+    # -- masked argmax, lowest index wins -------------------------------
+    masked = jnp.where(feasible, score, -jnp.inf)
+    best = jnp.max(masked)
+    choice = jnp.min(jnp.where(masked == best, col, jnp.int32(_BIG)))
+    return feasible, best, choice
+
+
 def _solver_kernel(
     midx_ref,      # SMEM [B] int32: static-mask row per pod
     podreq_ref,    # SMEM [B*R] int32 (per-pod scalars, row-major flat)
@@ -79,71 +163,14 @@ def _solver_kernel(
 
         req_state = reqout_ref[:, :]
         nzr_state = nzrout_ref[:, :]
-        free = alloc - req_state  # [R, N]
-
-        # -- fit (assignment._fits semantics) ---------------------------
-        fits_all = None
-        fits_pods = None
-        all_zero = None
-        for d in range(r):
-            s = podreq_ref[t * r + d]
-            ok = s <= free[d:d + 1, :]  # [1, N]
-            if d >= NUM_FIXED_DIMS:
-                ok = ok | (s == 0)
-            fits_all = ok if fits_all is None else (fits_all & ok)
-            if d == PODS:
-                fits_pods = ok
-            else:
-                zero_d = s == 0
-                all_zero = (
-                    zero_d if all_zero is None else (all_zero & zero_d)
-                )
-        # Mosaic can't select between i1 vectors: route through int32
-        fits = jnp.where(
-            all_zero,
-            fits_pods.astype(jnp.int32),
-            fits_all.astype(jnp.int32),
-        ) > 0  # [1, N]
-        feasible = fits & smask & valid
-
-        # -- score (ops/scores.py arithmetic, transposed) ---------------
-        p0 = podnzr_ref[t * 2].astype(jnp.float32)
-        p1 = podnzr_ref[t * 2 + 1].astype(jnp.float32)
-        req_tot = nzr_state.astype(jnp.float32) + jnp.concatenate(
-            [
-                jnp.full((1, n), 0.0, jnp.float32) + p0,
-                jnp.full((1, n), 0.0, jnp.float32) + p1,
-            ],
-            axis=0,
-        )  # [2, N]
-        score = jnp.zeros((1, n), dtype=jnp.float32)
-        if w_least:
-            raw = jnp.floor(
-                (caps - req_tot) * MAX_NODE_SCORE / cap_safe + _EPS
-            )
-            per_dim = jnp.where((caps == 0) | (req_tot > caps), 0.0, raw)
-            score += w_least * jnp.floor(
-                jnp.sum(per_dim, axis=0)[None] / 2.0 + _EPS
-            )
-        if w_balanced:
-            frac = jnp.where(caps == 0, 1.0, req_tot / cap_safe)
-            diff = jnp.abs(frac[0:1, :] - frac[1:2, :])
-            ba = jnp.trunc((1.0 - diff) * MAX_NODE_SCORE + _EPS)
-            ba = jnp.where(
-                (frac[0:1, :] >= 1.0) | (frac[1:2, :] >= 1.0), 0.0, ba
-            )
-            score += w_balanced * ba
-        if w_most:
-            raw = jnp.floor(req_tot * MAX_NODE_SCORE / cap_safe + _EPS)
-            per_dim = jnp.where((caps == 0) | (req_tot > caps), 0.0, raw)
-            score += w_most * jnp.floor(
-                jnp.sum(per_dim, axis=0)[None] / 2.0 + _EPS
-            )
-
-        # -- masked argmax, lowest index wins ---------------------------
-        masked = jnp.where(feasible, score, -jnp.inf)
-        best = jnp.max(masked)
-        choice = jnp.min(jnp.where(masked == best, col, jnp.int32(_BIG)))
+        feasible, _best, choice = _step_fit_score_argmax(
+            alloc, caps, cap_safe, valid, col, smask,
+            req_state, nzr_state,
+            lambda d: podreq_ref[t * r + d],
+            podnzr_ref[t * 2].astype(jnp.float32),
+            podnzr_ref[t * 2 + 1].astype(jnp.float32),
+            r=r, w_least=w_least, w_balanced=w_balanced, w_most=w_most,
+        )
         placed = jnp.any(feasible) & is_active
 
         asg_ref[t] = jnp.where(placed, choice, -1)
@@ -161,6 +188,120 @@ def _solver_kernel(
         return 0
 
     jax.lax.fori_loop(0, chunk, body, 0)
+
+
+def _shard_candidate_kernel(
+    podreq_ref,    # SMEM [R] int32: this pod's request row
+    podnzr_ref,    # SMEM [2] int32
+    midx_ref,      # SMEM [1] int32: static-mask row index
+    alloc_ref,     # VMEM [R, N] int32 (N = the SHARD's node rows)
+    req_ref,       # VMEM [R, N] int32 shard-local requested state
+    nzr_ref,       # VMEM [2, N] int32
+    valid_ref,     # VMEM [1, N] int32 (0/1)
+    rows_ref,      # VMEM [U, N] int32 (0/1) shard-local mask COLUMNS
+    score_ref,     # OUT SMEM [1] float32: shard-best masked score
+    idx_ref,       # OUT SMEM [1] int32: shard-LOCAL best node index
+    *,
+    r: int,
+    w_least: int,
+    w_balanced: int,
+    w_most: int,
+):
+    """One pod step's shard-local candidate: fused fit + score + masked
+    argmax over THIS shard's node columns (``_step_fit_score_argmax``,
+    the SAME body ``_solver_kernel`` runs per step -- state update
+    excluded: it needs the cross-shard winner, which the caller
+    combines OUTSIDE via the mesh collective). Bit-compatible with
+    ``assignment._greedy_assign_impl`` by construction; ties resolve
+    to the lowest GLOBAL index because shard i's global indices all
+    precede shard i+1's."""
+    n = alloc_ref.shape[1]
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
+    alloc = alloc_ref[:, :]
+    caps = alloc[:2, :].astype(jnp.float32)
+    cap_safe = jnp.maximum(caps, 1.0)
+    valid = valid_ref[0:1, :] > 0
+    smask = rows_ref[pl.ds(midx_ref[0], 1), :] > 0  # [1, N]
+
+    _feasible, best, choice = _step_fit_score_argmax(
+        alloc, caps, cap_safe, valid, col, smask,
+        req_ref[:, :], nzr_ref[:, :],
+        lambda d: podreq_ref[d],
+        podnzr_ref[0].astype(jnp.float32),
+        podnzr_ref[1].astype(jnp.float32),
+        r=r, w_least=w_least, w_balanced=w_balanced, w_most=w_most,
+    )
+    score_ref[0] = best
+    idx_ref[0] = choice
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "interpret")
+)
+def pallas_shard_candidate(
+    alloc_t: jnp.ndarray,  # [R, N] int32, transposed shard-local
+    req_t: jnp.ndarray,  # [R, N] int32
+    nzr_t: jnp.ndarray,  # [2, N] int32
+    valid_row: jnp.ndarray,  # [1, N] int32
+    rows: jnp.ndarray,  # [U, N] int32 shard-local mask columns
+    pod_req: jnp.ndarray,  # [R] int32
+    pod_nzr: jnp.ndarray,  # [2] int32
+    mask_index: jnp.ndarray,  # [] or [1] int32
+    config: GreedyConfig = GreedyConfig(),
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One pod's fused shard-local candidate (ops/assignment
+    ``_mesh_shard_solver``'s TPU step body): returns (best_score [],
+    best_local_idx []) for this shard. The caller owns the cross-shard
+    combine and the winner's state update."""
+    r, n = alloc_t.shape
+    u = rows.shape[0]
+    kernel = functools.partial(
+        _shard_candidate_kernel,
+        r=r,
+        w_least=config.least_allocated_weight,
+        w_balanced=config.balanced_allocation_weight,
+        w_most=config.most_allocated_weight,
+    )
+
+    def whole(*_):
+        return (0, 0)
+
+    def whole1(*_):
+        return (0,)
+
+    best, idx = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((1,), jnp.float32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ),
+        in_specs=[
+            pl.BlockSpec((r,), whole1, memory_space=pltpu.SMEM),
+            pl.BlockSpec((2,), whole1, memory_space=pltpu.SMEM),
+            pl.BlockSpec((1,), whole1, memory_space=pltpu.SMEM),
+            pl.BlockSpec((r, n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((r, n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((u, n), whole, memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1,), whole1, memory_space=pltpu.SMEM),
+            pl.BlockSpec((1,), whole1, memory_space=pltpu.SMEM),
+        ),
+        interpret=interpret,
+    )(
+        pod_req.astype(jnp.int32),
+        pod_nzr.astype(jnp.int32),
+        mask_index.astype(jnp.int32).reshape(1),
+        alloc_t,
+        req_t,
+        nzr_t,
+        valid_row,
+        rows,
+    )
+    return best[0], idx[0]
 
 
 @functools.partial(

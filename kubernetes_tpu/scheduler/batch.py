@@ -66,7 +66,10 @@ from kubernetes_tpu.ops.affinity import (
     pack_affinity_batch,
     pad_affinity_tensors,
 )
-from kubernetes_tpu.ops.host_masks import static_mask_compact
+from kubernetes_tpu.ops.host_masks import (
+    mask_rows_upload,
+    static_mask_compact,
+)
 from kubernetes_tpu.ops.scoring import (
     ScoreEnvelopeExceeded,
     batch_selector_spread_live,
@@ -364,6 +367,12 @@ class BatchScheduler(Scheduler):
         # scatters. KTPU_MESH_DELTA=0 restores the PR-5 counted
         # full-upload fallback (the escape hatch the
         # allow_scatter=False seam in _negotiate_device_state serves).
+        # Greedy mesh batches additionally solve on the shard_map'd
+        # PALLAS tier (PR 10, ops/assignment._mesh_shard_solver):
+        # per-shard fused step + one best-of-shards combine per pod,
+        # ladder [pallas, xla] with breaker fallback to the GSPMD twin;
+        # KTPU_MESH_PALLAS=0 pins the twin-only behavior (predicate:
+        # ops/assignment.mesh_pallas_candidate).
         self.mesh_delta = (
             mesh is not None
             and os.environ.get("KTPU_MESH_DELTA", "1") != "0"
@@ -728,14 +737,29 @@ class BatchScheduler(Scheduler):
         the fused kernel (shared predicate ops.assignment
         .pallas_candidate) -- otherwise a shape-ineligible batch would
         run the identical XLA solve twice on failure and charge it to
-        the pallas breaker. The XLA scan is always available. A mesh
-        never offers pallas: the fused kernels are whole-array
-        single-core programs, while mesh solves are GSPMD-partitioned
-        XLA lowerings."""
-        from kubernetes_tpu.ops.assignment import pallas_candidate
+        the pallas breaker. The XLA scan is always available.
+
+        A MESH offers the shard_map'd Pallas tier instead (shared
+        predicate ops.assignment.mesh_pallas_candidate: greedy batches,
+        KTPU_MESH_PALLAS=1, node axis divisible by the mesh): each
+        device runs the fused whole-array step on its own carry shard
+        with one best-of-shards combine per pod. The single-core
+        whole-array kernels themselves are still never attempted on a
+        mesh; a faulted mesh-pallas solve steps down to the GSPMD XLA
+        twin through the same breaker."""
+        from kubernetes_tpu.ops.assignment import (
+            mesh_pallas_candidate,
+            pallas_candidate,
+        )
 
         if self.mesh is None and pallas_candidate(
             mode, b, n_cap, r_dims, u_rows
+        ):
+            return [TIER_PALLAS, TIER_XLA]
+        if (
+            self.mesh is not None
+            and self.mesh_delta
+            and mesh_pallas_candidate(mode, n_cap, self.mesh)
         ):
             return [TIER_PALLAS, TIER_XLA]
         return [TIER_XLA]
@@ -913,6 +937,19 @@ class BatchScheduler(Scheduler):
             for k, v in d.items():
                 out[k] = out.get(k, 0.0) + v
         return out
+
+    @property
+    def mesh_solver_tier(self) -> str:
+        """Which mesh tier the run ACTUALLY solved on, for the perf
+        matrix's ``solver_mesh_tier`` label: "pallas" once any batch
+        rode the shard_map'd Pallas tier, else "xla" (the GSPMD twin --
+        either KTPU_MESH_PALLAS=0, an ineligible shape, or every pallas
+        attempt faulted to the twin). Empty off-mesh."""
+        if self.mesh is None:
+            return ""
+        if self.ladder.solves_by_tier.get(TIER_PALLAS):
+            return "pallas"
+        return "xla"
 
     def _pending_has_ports(self) -> bool:
         with self._pending_cv:
@@ -1726,7 +1763,10 @@ class BatchScheduler(Scheduler):
                 ("nzr", nzr),
                 ("midx", midx),
                 ("active", active.astype(np.int32)),
-                ("rows", rows.astype(np.int32)),
+                # on a mesh the rows ship as a separate bool operand,
+                # column-sharded host-side (ops/host_masks.py) -- each
+                # shard uploads only its [U, N/P] mask columns
+                ("rows", mask_rows_upload(rows, self.mesh)),
             ]
             if not static_ok:
                 pieces.append(("alloc", nt.allocatable))
@@ -3213,14 +3253,21 @@ class BatchScheduler(Scheduler):
         """Sharded-twin warmup: compile every packed-upload layout the
         MESH run loop can hit -- cold (static+carry ride the replicated
         buffer, resharded once on device), carry-refresh, and
-        steady-state delta-scatter -- plus the single constrained
-        layout. Absent families ride as real zero tensors on the mesh
+        steady-state delta-scatter -- for BOTH mesh tiers (the
+        shard_map'd Pallas tier the ladder attempts first when
+        mesh_pallas_candidate holds, and the GSPMD XLA twin the
+        breakers fall back to), plus the single constrained layout.
+        Absent families ride as real zero tensors on the mesh
         (fam_pieces), so the constrained dispatch has exactly ONE
         signature per (state-variant, mesh shape): the multichip
         dryrun's zero-recompile probe (mesh_packed_cache_size) pins
-        that the steady phase never compiles past this set. The steady
-        solve is re-run timed post-compile (pad_solve_seconds) for the
-        AutoBatchController rung ladder."""
+        that the steady phase never compiles past this set -- the probe
+        covers the Pallas-tier signatures too, since both tiers share
+        the one jitted mesh solver. The steady solve is re-run timed
+        post-compile (pad_solve_seconds, on the tier dispatch will
+        actually use) for the AutoBatchController rung ladder."""
+        from kubernetes_tpu.ops.assignment import mesh_pallas_candidate
+
         n = nt.capacity
         r = nt.dims.num_dims
         base = [
@@ -3228,7 +3275,9 @@ class BatchScheduler(Scheduler):
             ("nzr", np.zeros((padded, 2), dtype=np.int32)),
             ("midx", np.zeros(padded, dtype=np.int32)),
             ("active", np.zeros(padded, dtype=np.int32)),
-            ("rows", np.zeros((MASK_ROW_BUCKET, n), dtype=np.int32)),
+            ("rows", mask_rows_upload(
+                np.zeros((MASK_ROW_BUCKET, n), dtype=bool), self.mesh
+            )),
         ]
         static_pieces = [
             ("alloc", np.zeros((n, r), dtype=np.int32)),
@@ -3239,35 +3288,44 @@ class BatchScheduler(Scheduler):
             ("nzr_state", np.zeros((n, 2), dtype=np.int32)),
         ]
         delta_slots = _delta_slot_pieces(n, r)
-        kw = dict(
-            config=self.solver_config, mode=self.solver_mode,
-            mesh=self.mesh,
-        )
-        cold = solve_packed(
-            base + static_pieces + carry_pieces, None, None, None, None,
-            **kw,
-        )
-        jax.block_until_ready(cold)
-        _, _, _, alloc_d, valid_d = cold
-        refresh = solve_packed(
-            base + carry_pieces, alloc_d, valid_d, None, None, **kw
-        )
-        jax.block_until_ready(refresh)
-        _, req_d, nzr_d, _, _ = refresh
-        steady = solve_packed(
-            base + delta_slots, alloc_d, valid_d, req_d, nzr_d, **kw
-        )
-        jax.block_until_ready(steady)
-        # median of 3 (see _warmup_at): one noisy sample must not make
-        # the calibrated ladder nondeterministic run-to-run
-        samples = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            jax.block_until_ready(solve_packed(
+        tiers = [False]  # the GSPMD twin always warms (breaker target)
+        if mesh_pallas_candidate(self.solver_mode, n, self.mesh):
+            tiers.insert(0, True)
+        alloc_d = valid_d = req_d = nzr_d = None
+        for allow_pallas in tiers:
+            kw = dict(
+                config=self.solver_config, mode=self.solver_mode,
+                mesh=self.mesh, allow_pallas=allow_pallas,
+            )
+            cold = solve_packed(
+                base + static_pieces + carry_pieces,
+                None, None, None, None, **kw,
+            )
+            jax.block_until_ready(cold)
+            _, _, _, alloc_d, valid_d = cold
+            refresh = solve_packed(
+                base + carry_pieces, alloc_d, valid_d, None, None, **kw
+            )
+            jax.block_until_ready(refresh)
+            _, req_d, nzr_d, _, _ = refresh
+            steady = solve_packed(
                 base + delta_slots, alloc_d, valid_d, req_d, nzr_d, **kw
-            ))
-            samples.append(time.perf_counter() - t0)
-        self.pad_solve_seconds[padded] = sorted(samples)[1]
+            )
+            jax.block_until_ready(steady)
+            if allow_pallas is not tiers[0]:
+                continue
+            # median of 3 (see _warmup_at) on the FIRST-attempt tier:
+            # one noisy sample must not make the calibrated ladder
+            # nondeterministic run-to-run
+            samples = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                jax.block_until_ready(solve_packed(
+                    base + delta_slots, alloc_d, valid_d, req_d, nzr_d,
+                    **kw,
+                ))
+                samples.append(time.perf_counter() - t0)
+            self.pad_solve_seconds[padded] = sorted(samples)[1]
         if not full or n > CONSTRAINED_NODE_CAP:
             # latency rungs warm the basic path only; over the
             # constrained node cap every constrained batch routes host

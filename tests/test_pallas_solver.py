@@ -76,3 +76,62 @@ def test_multi_chunk_grid(seed=3):
     assert np.array_equal(np.asarray(a1), np.asarray(a2))
     assert np.array_equal(np.asarray(r1), np.asarray(r2))
     assert np.array_equal(np.asarray(z1), np.asarray(z2))
+
+
+@pytest.mark.parametrize("seed", [1, 13])
+@pytest.mark.parametrize(
+    "cfg",
+    [
+        GreedyConfig(),
+        GreedyConfig(
+            least_allocated_weight=0,
+            balanced_allocation_weight=0,
+            most_allocated_weight=1,
+        ),
+    ],
+)
+def test_shard_candidate_kernel_matches_jnp_step(seed, cfg):
+    """The per-shard candidate kernel (the mesh Pallas tier's TPU step
+    body, ops/pallas_solver.pallas_shard_candidate) vs the jnp step the
+    shard_map twin runs on non-TPU backends: identical (best score,
+    lowest-index argmax) per pod over randomized shard-local state --
+    the bit-parity that makes the cross-shard combine exact on either
+    body."""
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.ops.assignment import _combined_score, _fits
+    from kubernetes_tpu.ops.pallas_solver import pallas_shard_candidate
+
+    n, r, u = 128, 6, 8
+    (alloc, requested, nzr, valid, pod_req, pod_nzr, rows, midx,
+     _active) = _random_problem(seed, n=n, b=16, r=r)
+    for k in range(16):
+        free = jnp.asarray(alloc - requested)
+        fits = _fits(free, jnp.asarray(pod_req[k]))
+        feasible = (
+            fits & jnp.asarray(rows[midx[k]]) & jnp.asarray(valid)
+        )
+        score = _combined_score(
+            jnp.asarray(alloc[:, :2]), jnp.asarray(nzr),
+            jnp.asarray(pod_nzr[k]), cfg,
+        )
+        masked = jnp.where(feasible, score, -jnp.inf)
+        best_t = float(jnp.max(masked))
+        idx_t = int(jnp.min(jnp.where(
+            masked == jnp.max(masked), jnp.arange(n), 1 << 30
+        )))
+        best_k, idx_k = pallas_shard_candidate(
+            jnp.asarray(alloc.T), jnp.asarray(requested.T),
+            jnp.asarray(nzr.T),
+            jnp.asarray(valid.astype(np.int32))[None, :],
+            jnp.asarray(rows.astype(np.int32)),
+            jnp.asarray(pod_req[k]), jnp.asarray(pod_nzr[k]),
+            jnp.asarray(np.int32(midx[k])),
+            config=cfg, interpret=True,
+        )
+        if bool(jnp.any(feasible)):
+            assert float(best_k) == best_t and int(idx_k) == idx_t, (
+                seed, k, float(best_k), best_t, int(idx_k), idx_t
+            )
+        else:
+            assert float(best_k) == best_t == float("-inf")

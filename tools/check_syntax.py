@@ -26,7 +26,14 @@ import os
 import sys
 from typing import Iterable, List, Tuple
 
-DEFAULT_ROOTS = ("kubernetes_tpu", "tests", "tools")
+#: every tree a runtime imports from: the packages pytest collects,
+#: plus the perf-matrix runner package and the top-level entry scripts
+#: (bench.py / the driver's __graft_entry__) -- a syntax error there
+#: fails CI loudly instead of surfacing mid-benchmark
+DEFAULT_ROOTS = (
+    "kubernetes_tpu", "tests", "tools", "benchmarks",
+    "bench.py", "__graft_entry__.py",
+)
 
 
 def iter_python_files(roots: Iterable[str]) -> Iterable[str]:
