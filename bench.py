@@ -1192,6 +1192,19 @@ def main() -> None:
         # chaos runs report the degradation profile next to throughput
         record["fault_profile"] = fault_profile
         record["solves_by_tier"] = dict(sched.ladder.solves_by_tier)
+    pre = getattr(sched, "preemptor", None)
+    if pre is not None and pre.waves:
+        # preemption-wave ledger (ISSUE 11): what the waves actually
+        # did -- victims book per solver tier only after their eviction
+        # transaction landed, so these are evictions, not proposals
+        record["preemption"] = {
+            "waves": pre.waves,
+            "wave_tier": pre.wave_solver_tier,
+            "victims_by_tier": dict(pre.victims_by_tier),
+            "budget_denials": pre.budget_denials,
+            "victims_slow_death": pre.victims_slow_death,
+            "wave_solves_by_tier": dict(pre.ladder.solves_by_tier),
+        }
     print(json.dumps(record))
 
 

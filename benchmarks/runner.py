@@ -148,7 +148,20 @@ def _build_pod(name: str, spec: Dict[str, Any], idx: int):
     )
     if spec.get("labels"):
         w.labels(**spec["labels"])
-    if spec.get("priority") is not None:
+    if spec.get("priority_mix"):
+        # weighted priority rotation, e.g.
+        #   priority_mix: [{priority: 0, weight: 9}, {priority: 100,
+        #   weight: 1}]
+        # -- the priority-inversion-storm shape: a low-priority flood
+        # with a high-priority tail interleaved through it, so the high
+        # band must cut the queue AND preempt to meet its SLO
+        pattern: List[int] = []
+        for m in spec["priority_mix"]:
+            pattern.extend(
+                [int(m["priority"])] * int(m.get("weight", 1))
+            )
+        w.priority(pattern[idx % len(pattern)])
+    elif spec.get("priority") is not None:
         w.priority(int(spec["priority"]))
     sp = spec.get("spread")
     if sp:
@@ -226,6 +239,25 @@ def _wait_live_bound(client: Client, timeout: float) -> bool:
     return False
 
 
+def _pdb_from_spec(spec: Dict[str, Any], name: str):
+    """One PodDisruptionBudget from a workload's ``pdb:`` block
+    ({match_labels, min_available, max_unavailable}) -- shared by the
+    drain-wave, drain-via-preemption, and preemption-wave setups so the
+    spec shape has one reader."""
+    from kubernetes_tpu.api.types import LabelSelector, PodDisruptionBudget
+
+    pdb = PodDisruptionBudget(
+        selector=LabelSelector(
+            match_labels=dict(spec.get("match_labels") or {})
+        ),
+        min_available=spec.get("min_available"),
+        max_unavailable=spec.get("max_unavailable"),
+    )
+    pdb.metadata.name = name
+    pdb.metadata.namespace = "default"
+    return pdb
+
+
 def _lifecycle_setup(
     lifecycle: Dict[str, Any],
     wl: Dict[str, Any],
@@ -234,11 +266,11 @@ def _lifecycle_setup(
     informers: InformerFactory,
     num_nodes: int,
     injector,
+    sched=None,
 ):
     """Build the scenario actor for a ``lifecycle:`` workload. Returns
     (components-to-stop, scenario(coll, timeout_s) callable, counters,
     stop event that aborts an in-progress scenario)."""
-    from kubernetes_tpu.api.types import LabelSelector, PodDisruptionBudget
     from kubernetes_tpu.controllers import DisruptionController, NodeDrainer
     from kubernetes_tpu.robustness.faults import (
         FaultInjector, FaultPoint, FaultProfile, PointConfig,
@@ -256,22 +288,69 @@ def _lifecycle_setup(
     # draining nodes under the settle checks for minutes
     stop_evt = threading.Event()
 
+    if mode == "drain_via_preemption":
+        # ISSUE-11 acceptance shape: cordoned nodes empty by DEVICE-
+        # CHOSEN per-pod evictees (the preemptor's victim-search kernel
+        # run as a plan) instead of whole-node eviction. The row's
+        # counters carry the whole-node BASELINE (every resident at
+        # drain start) next to what was actually evicted, so the
+        # strictly-fewer claim is a label, not a vibe.
+        disruption = DisruptionController(client, informers)
+        disruption.start()
+        stoppers.append(disruption)
+        pdb_spec = lifecycle.get("pdb")
+        if pdb_spec:
+            client.create_pdb(
+                _pdb_from_spec(pdb_spec, "drain-preempt-budget")
+            )
+        if sched is not None and getattr(sched, "preemptor", None):
+            sched.preemptor.disruption = disruption
+        respawner = PodRespawner(client)
+        respawner.start()
+        stoppers.append(respawner)
+        drainer = NodeDrainer(
+            client, disruption=disruption,
+            should_abort=stop_evt.is_set,
+            preemptor=getattr(sched, "preemptor", None),
+        )
+        counters["drainer"] = drainer
+        counters["respawner"] = respawner
+        counters["baseline_pods"] = 0
+
+        def scenario(coll, timeout_s):
+            _wait_fraction_bound(coll, at_fraction, timeout_s)
+            waves = int(lifecycle.get("waves", 3))
+            per = int(lifecycle.get("nodes_per_wave", 2))
+            wave_timeout = float(lifecycle.get("wave_timeout_s", 60))
+            idx = 0
+            for _w in range(waves):
+                if stop_evt.is_set():
+                    return
+                victims = [
+                    f"node-{(idx + j) % num_nodes}" for j in range(per)
+                ]
+                idx += per
+                for v in victims:
+                    if stop_evt.is_set():
+                        return
+                    pods, _rv = client.list_pods()
+                    counters["baseline_pods"] += sum(
+                        1 for p in pods if p.spec.node_name == v
+                    )
+                    drainer.drain_via_preemption(v, timeout=wave_timeout)
+                if lifecycle.get("uncordon", True):
+                    for v in victims:
+                        drainer.uncordon(v)
+
+        return stoppers, scenario, counters, stop_evt
+
     if mode == "drain_wave":
         disruption = DisruptionController(client, informers)
         disruption.start()
         stoppers.append(disruption)
         pdb_spec = lifecycle.get("pdb")
         if pdb_spec:
-            pdb = PodDisruptionBudget(
-                selector=LabelSelector(
-                    match_labels=dict(pdb_spec.get("match_labels") or {})
-                ),
-                min_available=pdb_spec.get("min_available"),
-                max_unavailable=pdb_spec.get("max_unavailable"),
-            )
-            pdb.metadata.name = "wave-budget"
-            pdb.metadata.namespace = "default"
-            client.create_pdb(pdb)
+            client.create_pdb(_pdb_from_spec(pdb_spec, "wave-budget"))
         respawner = PodRespawner(client)
         respawner.start()
         stoppers.append(respawner)
@@ -630,6 +709,45 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         if streaming.band_priority_threshold is not None:
             sched.queue.band_threshold = streaming.band_priority_threshold
 
+    # workload-scoped preemption wave wiring (ISSUE 11): the shared
+    # DisruptionController PDB gate on the scheduler's Preemptor (every
+    # wave eviction spends can_disrupt -- zero overspend by
+    # construction), an optional PDB over the fill, and a respawner so
+    # evicted victims re-enter as pending arrivals (the cascade shape).
+    # Counters land in the row's preemption_* labels.
+    preempt_cfg = wl.get("preemption")
+    preempt_stoppers: List[Any] = []
+    preempt_metrics0: Dict[str, float] = {}
+    if preempt_cfg:
+        from kubernetes_tpu.controllers import DisruptionController
+        from kubernetes_tpu.robustness.lifecycle import PodRespawner
+        from kubernetes_tpu.utils import metrics as _metrics
+
+        disruption = DisruptionController(client, informers)
+        disruption.start()
+        sched.preemptor.disruption = disruption
+        preempt_stoppers.append(disruption)
+        pdb_spec = preempt_cfg.get("pdb")
+        if pdb_spec:
+            client.create_pdb(
+                _pdb_from_spec(pdb_spec, "preemption-budget")
+            )
+        rsp_prefix = preempt_cfg.get("respawn_prefix")
+        if rsp_prefix:
+            respawner = PodRespawner(
+                client,
+                should_respawn=(
+                    lambda p: p.metadata.name.startswith(rsp_prefix)
+                ),
+            )
+            respawner.start()
+            preempt_stoppers.append(respawner)
+        preempt_metrics0 = {
+            "blocked": _metrics.evictions_blocked_by_pdb.value(),
+            "nominations_set": _metrics.nominations_set.value(),
+            "nominations_cleared": _metrics.nominations_cleared.value(),
+        }
+
     for i in range(num_nodes):
         nw = make_node(f"node-{i}").capacity(
             cpu=str(node_spec.get("cpu", defaults.get("node_cpu", "32"))),
@@ -774,7 +892,7 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
             lifecycle_counters, lifecycle_stop,
         ) = _lifecycle_setup(
             lifecycle, wl, server, client, informers, num_nodes,
-            injector,
+            injector, sched=sched,
         )
 
     hollow = None
@@ -1021,6 +1139,11 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
                     evictions_blocked=drn.evictions_blocked,
                     drains_completed=drn.drains,
                 )
+                if drn.preempt_planned or drn.preempt_left_running:
+                    lifecycle_counters.update(
+                        preempt_planned=drn.preempt_planned,
+                        preempt_left_running=drn.preempt_left_running,
+                    )
             rsp = lifecycle_counters.pop("respawner", None)
             if rsp is not None:
                 lifecycle_counters["pods_respawned"] = rsp.respawned
@@ -1135,6 +1258,48 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
             result["solver"]["tensor_full_repacks"] = tc.full_repacks
             result["solver"]["tensor_rows_added"] = tc.rows_added
             result["solver"]["tensor_rows_retired"] = tc.rows_retired
+        if preempt_cfg:
+            from kubernetes_tpu.utils import metrics as _metrics
+
+            pre = sched.preemptor
+            prec: Dict[str, Any] = {
+                "waves": pre.waves,
+                # which tier the LAST wave actually solved on (the
+                # solver_mesh_tier analogue: pallas / xla / host)
+                "wave_tier": pre.wave_solver_tier,
+                "budget_denials": pre.budget_denials,
+                "victims_slow_death": pre.victims_slow_death,
+                "device_preemptions": pre.device_preemptions,
+                "host_preemptions": pre.host_preemptions,
+                "evictions_blocked_by_pdb": int(
+                    _metrics.evictions_blocked_by_pdb.value()
+                    - preempt_metrics0["blocked"]
+                ),
+                "nominations_set": int(
+                    _metrics.nominations_set.value()
+                    - preempt_metrics0["nominations_set"]
+                ),
+                "nominations_cleared": int(
+                    _metrics.nominations_cleared.value()
+                    - preempt_metrics0["nominations_cleared"]
+                ),
+            }
+            for tier, n in sorted(pre.victims_by_tier.items()):
+                prec[f"victims_{tier}"] = n
+            thr = preempt_cfg.get("high_priority_threshold")
+            if thr is not None:
+                # the inversion pin: with a threshold declared, EVERY
+                # high-band pod must have bound -- an unbound high pod
+                # fails the row even when the bulk fraction passed
+                unbound = sum(
+                    1 for p in client.list_pods()[0]
+                    if p.spec.priority >= int(thr)
+                    and not p.spec.node_name
+                    and p.metadata.deletion_timestamp is None
+                )
+                prec["high_priority_unbound"] = unbound
+                result["ok"] = bool(result["ok"]) and unbound == 0
+            result["preemption"] = prec
         if lifecycle_counters:
             result["lifecycle"] = lifecycle_counters
         if streaming_rec:
@@ -1159,6 +1324,11 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         if lifecycle_stop is not None:
             lifecycle_stop.set()
         for comp in lifecycle_stoppers:
+            try:
+                comp.stop()
+            except Exception:  # noqa: BLE001 - teardown keeps going
+                pass
+        for comp in preempt_stoppers:
             try:
                 comp.stop()
             except Exception:  # noqa: BLE001 - teardown keeps going
@@ -1197,6 +1367,12 @@ def to_data_items(results: List[Dict[str, Any]]) -> Dict[str, Any]:
             {
                 f"partition_{k}": str(v)
                 for k, v in (r.get("partition") or {}).items()
+            }
+        )
+        labels.update(
+            {
+                f"preemption_{k}": str(v)
+                for k, v in (r.get("preemption") or {}).items()
             }
         )
         if r.get("error") or not r.get("ok", False):

@@ -419,22 +419,41 @@ class APIServer:
             )
             return obj
 
-    def delete(self, kind: str, namespace: str, name: str) -> Any:
+    def delete(
+        self, kind: str, namespace: str, name: str,
+        expect_uid: Optional[str] = None,
+    ) -> Any:
+        """``expect_uid``: uid-preconditioned delete (the Kubernetes
+        delete-options Preconditions.UID analogue), checked atomically
+        under the store lock -- a delayed eviction can fence itself
+        against a respawned same-name incarnation without a racy
+        read-then-delete."""
         with self._lock:
             self._ensure_kind(kind)
-            obj = self._stores[kind].pop((namespace, name), None)
+            obj = self._stores[kind].get((namespace, name))
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
+            if expect_uid is not None and obj.metadata.uid != expect_uid:
+                raise Conflict(
+                    f"{kind} {namespace}/{name}: uid "
+                    f"{obj.metadata.uid} does not match precondition "
+                    f"{expect_uid}"
+                )
+            self._stores[kind].pop((namespace, name))
             rv = self._next_rv()
             self._broadcast(kind, WatchEvent(DELETED, obj, rv))
             return obj
 
     def delete_bulk(
-        self, kind: str, keys: List[Tuple[str, str]]
+        self, kind: str, keys: List[Tuple[str, str]],
+        missing_out: Optional[List[Tuple[str, str]]] = None,
     ) -> int:
         """Delete many objects of one kind in a single transaction with
         one bulk watch fan-out (the eviction analogue of bind_bulk);
-        missing keys are skipped. Returns the number deleted."""
+        missing keys are skipped (and appended to ``missing_out`` when
+        given, so an evictor that pre-spent a disruption budget can
+        refund the units whose delete evicted nothing). Returns the
+        number deleted."""
         events: List[WatchEvent] = []
         with self._lock:
             self._ensure_kind(kind)
@@ -442,6 +461,8 @@ class APIServer:
             for namespace, name in keys:
                 obj = store.pop((namespace, name), None)
                 if obj is None:
+                    if missing_out is not None:
+                        missing_out.append((namespace, name))
                     continue
                 events.append(WatchEvent(DELETED, obj, self._next_rv()))
             self._broadcast_many(kind, events)

@@ -38,9 +38,16 @@ class Client:
     def delete_pod(self, namespace: str, name: str) -> Pod:
         return self._server.delete("Pod", namespace, name)
 
-    def delete_pods_bulk(self, keys: List[Tuple[str, str]]) -> int:
+    def delete_pods_bulk(
+        self, keys: List[Tuple[str, str]], missing_out=None
+    ) -> int:
         """One transaction deleting many pods (preemption evicts whole
-        victim sets); missing pods are skipped."""
+        victim sets); missing pods are skipped (reported via
+        ``missing_out`` when given)."""
+        if missing_out is not None:
+            return self._server.delete_bulk(
+                "Pod", keys, missing_out=missing_out
+            )
         return self._server.delete_bulk("Pod", keys)
 
     def bind(self, binding: Binding, binder: str = None) -> Pod:

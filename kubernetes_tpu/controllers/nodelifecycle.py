@@ -150,7 +150,11 @@ class NodeLifecycleController:
                 )
                 self.evictions += 1
             except KeyError:
-                pass  # already gone; reconcile recomputes from live pods
+                # already gone: OUR grant evicted nothing -- refund it
+                # (the reconcile would eventually recompute, but sibling
+                # pods under the PDB shouldn't be denied meanwhile)
+                if self.disruption is not None:
+                    self.disruption.refund_disruption(pod)
             except Exception:
                 logger.exception("evicting pod %s", pod.key())
                 if self.disruption is not None:
@@ -200,18 +204,30 @@ class NodeDrainer:
 
     def __init__(
         self, client, disruption=None, poll: float = 0.02,
-        should_abort=None,
+        should_abort=None, preemptor=None,
     ) -> None:
         """``should_abort``: optional nullary callable polled while a
         drain waits on budget-blocked pods -- lets a harness tear down a
-        long drain instead of waiting out the deadline."""
+        long drain instead of waiting out the deadline.
+
+        ``preemptor``: an optional scheduler Preemptor; when wired,
+        ``drain_via_preemption`` drives its device victim-search kernel
+        to pick PER-POD evictees (pods with a live destination) instead
+        of draining the whole node."""
         self.client = client
         self.disruption = disruption
         self.poll = poll
         self.should_abort = should_abort or (lambda: False)
+        self.preemptor = preemptor
         self.evictions = 0
         self.evictions_blocked = 0
         self.drains = 0
+        # drain-via-preemption observability: pods the kernel planned a
+        # destination for (and were evicted), vs pods left RUNNING on
+        # the cordoned node because no destination exists -- the
+        # strictly-fewer-evictions-than-whole-node ledger
+        self.preempt_planned = 0
+        self.preempt_left_running = 0
 
     def _set_unschedulable(self, node_name: str, value: bool) -> bool:
         def mutate(node: Node) -> None:
@@ -274,6 +290,11 @@ class NodeDrainer:
                     progressed = True
                 except KeyError:
                     progressed = True  # already gone
+                    if self.disruption is not None:
+                        # a concurrent path deleted it first: OUR grant
+                        # evicted nothing -- refund, or the unit leaks
+                        # until the reconcile recomputes
+                        self.disruption.refund_disruption(pod)
                 except Exception:
                     logger.exception("draining pod %s", pod.key())
                     if self.disruption is not None:
@@ -287,4 +308,170 @@ class NodeDrainer:
                 # everything left is budget-blocked: wait for earlier
                 # evictees to terminate/re-place and the reconcile loop
                 # to re-open the budget
+                time.sleep(self.poll)
+
+    def drain_via_preemption(
+        self,
+        node_name: str,
+        timeout: float = 30.0,
+        cordon: bool = True,
+        preemptor=None,
+    ) -> bool:
+        """Drain by DEVICE-CHOSEN evictees instead of the whole node:
+        the preemptor's victim-search kernel (run as a plan -- wave
+        priority clamped so it never cascades secondary evictions)
+        answers, per resident pod, whether a live destination exists
+        RIGHT NOW, with each planned pod's claim carried into the next
+        pod's answer. Only pods WITH a destination are evicted --
+        through the same ``can_disrupt`` budget as every other
+        voluntary disruption -- and pods with nowhere to go stay
+        RUNNING on the cordoned node (``preempt_left_running``): a
+        whole-node drain would evict them into a pending limbo while
+        freeing capacity nobody can use.
+
+        Pods the plan model cannot answer exactly (gang members,
+        affinity/spread/port/PVC carriers) take the classic
+        unconditional eviction path -- the scheduler re-places them with
+        its full filter pipeline.
+
+        Returns True when the node emptied within the deadline; False
+        leaves the cordoned node with its unplaceable (or
+        budget-blocked) residents still running."""
+        preemptor = preemptor or self.preemptor
+        if preemptor is None:
+            return self.drain(node_name, timeout=timeout, cordon=cordon)
+        if cordon and not self.cordon(node_name):
+            return False
+        deadline = time.monotonic() + timeout
+        blocked_prev: set = set()
+        evicted: dict = {}  # (ns, name) -> evicted incarnation's uid
+
+        def unfinished() -> bool:
+            # the left-running ledger reflects pods still RUNNING on
+            # the cordoned node when the drain hands back -- not pods
+            # that were merely transiently unplaceable in some round
+            # (those may be planned and evicted later)
+            try:
+                pods_now, _ = self.client.list_pods()
+                self.preempt_left_running += sum(
+                    1 for p in pods_now
+                    if p.spec.node_name == node_name
+                    and p.metadata.deletion_timestamp is None
+                )
+            except Exception:  # noqa: BLE001 - counting is best effort
+                pass
+            return False
+
+        while True:
+            pods_all, _rv = self.client.list_pods()
+            remaining = [
+                p for p in pods_all
+                if p.spec.node_name == node_name
+                and p.metadata.deletion_timestamp is None
+            ]
+            if not remaining:
+                self.drains += 1
+                return True
+            # let earlier evictees' REPLACEMENTS land before re-planning:
+            # a respawned clone (same name, new uid) that is still
+            # pending is about to claim the very capacity the next plan
+            # would count as free -- planning over it would evict pods
+            # whose destination evaporates, exactly the over-eviction
+            # this drain mode exists to avoid
+            settling = [
+                p for p in pods_all
+                if not p.spec.node_name
+                and p.metadata.deletion_timestamp is None
+                and evicted.get(
+                    (p.metadata.namespace, p.metadata.name)
+                ) not in (None, p.metadata.uid)
+            ]
+            if settling:
+                if time.monotonic() >= deadline or self.should_abort():
+                    return unfinished()
+                time.sleep(self.poll)
+                continue
+            # most-important-first plan order: the pods hardest to
+            # re-place elsewhere get first claim on the free capacity
+            # (mirrors the wave's priority-desc activeQ order)
+            remaining.sort(
+                key=lambda p: (
+                    -p.spec.priority,
+                    p.status.start_time or 0.0,
+                    p.metadata.name,
+                )
+            )
+            planable = [p for p in remaining if preemptor.plan_eligible(p)]
+            classic = [
+                p for p in remaining if not preemptor.plan_eligible(p)
+            ]
+            try:
+                plans = (
+                    preemptor.plan_replacements(
+                        planable, exclude_nodes=(node_name,)
+                    )
+                    if planable else []
+                )
+            except Exception:
+                # a concurrent chaos wave can have opened both wave-tier
+                # breakers (LadderExhausted); the drain must degrade to
+                # paced retries -- the breakers cool off -- never
+                # propagate out of a scenario thread mid-drain
+                logger.exception(
+                    "drain plan for %s failed; retrying paced", node_name
+                )
+                if time.monotonic() >= deadline or self.should_abort():
+                    return unfinished()
+                time.sleep(self.poll)
+                continue
+            evictees = [
+                p for p, dest in zip(planable, plans) if dest
+            ] + classic
+            stuck = [p for p, dest in zip(planable, plans) if not dest]
+            progressed = False
+            blocked_now: set = set()
+            classic_uids = {c.metadata.uid for c in classic}
+            for pod in evictees:
+                if (
+                    self.disruption is not None
+                    and not self.disruption.can_disrupt(pod)
+                ):
+                    if pod.metadata.uid not in blocked_prev:
+                        self.evictions_blocked += 1
+                    blocked_now.add(pod.metadata.uid)
+                    continue
+                try:
+                    self.client.delete_pod(
+                        pod.metadata.namespace, pod.metadata.name
+                    )
+                    self.evictions += 1
+                    evicted[
+                        (pod.metadata.namespace, pod.metadata.name)
+                    ] = pod.metadata.uid
+                    if pod.metadata.uid not in classic_uids:
+                        self.preempt_planned += 1
+                    progressed = True
+                except KeyError:
+                    progressed = True  # already gone
+                    if self.disruption is not None:
+                        # a concurrent path deleted it first: OUR grant
+                        # evicted nothing -- refund it
+                        self.disruption.refund_disruption(pod)
+                except Exception:
+                    logger.exception("draining pod %s", pod.key())
+                    if self.disruption is not None:
+                        self.disruption.refund_disruption(pod)
+            blocked_prev = blocked_now
+            if stuck and not evictees:
+                # every resident is unplaceable: evicting them would
+                # only trade running pods for pending ones. The drain
+                # reports back incomplete -- exactly what an operator
+                # needs to know before taking the node away.
+                return unfinished()
+            if time.monotonic() >= deadline or self.should_abort():
+                return unfinished()
+            if not progressed:
+                # evictable pods are budget-blocked, or stuck pods wait
+                # for capacity elsewhere: pace, then re-plan (earlier
+                # evictees re-placing frees destinations)
                 time.sleep(self.poll)

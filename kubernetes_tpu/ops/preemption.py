@@ -447,6 +447,33 @@ def _preempt_batch_kernel_packed(*args, num_pdbs: int):
     )
 
 
+def wave_pallas_eligible(pack: PreemptionPack, num_pdbs: int) -> bool:
+    """True when the fused Pallas tier can run this wave: no PDB
+    modeling (the Pallas kernel has none -- PDB waves take the jnp
+    twin), a victim axis that fits the 32-bit result masks, the env
+    kill-switch off, and a TPU backend (or the interpret-mode test
+    hook). The wave ladder (scheduler/preemption.py) consults this to
+    decide whether to offer the pallas tier at all."""
+    import os as _os
+
+    import jax as _jax
+
+    return (
+        num_pdbs == 0
+        and pack.v_max <= 32
+        and _os.environ.get("KTPU_PALLAS", "1") != "0"
+        and (
+            _jax.default_backend() == "tpu" or FORCE_PALLAS_INTERPRET
+        )
+    )
+
+
+def pack_num_pdbs(pack: PreemptionPack) -> int:
+    """The PDB-count the kernels are specialized on: zero when no victim
+    matches any budget (the common case compiles the budget loop away)."""
+    return int(pack.pdb_allowed.shape[0]) if pack.pdb_match.any() else 0
+
+
 def preempt_batch_device(
     pack: PreemptionPack,
     pods_req: np.ndarray,  # [B, R]
@@ -456,6 +483,7 @@ def preempt_batch_device(
     nom_prio: np.ndarray,  # [M]
     nom_node: np.ndarray,  # [M]
     cand_dedup: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+    tier: Optional[str] = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """One device round trip for a whole failed-pod group. Returns host
     arrays (chosen [B], victims [B, V], victims_violating [B, V],
@@ -465,10 +493,14 @@ def preempt_batch_device(
     candidate masks. The caller usually KNOWS the dedup structure (a
     wave shares a handful of static-mask rows x potential-node lists),
     and np.unique over a materialized [B, N] matrix measured ~1.1s at
-    1000x5000 -- half the preemption wave."""
-    import os as _os
+    1000x5000 -- half the preemption wave.
 
-    num_pdbs = int(pack.pdb_allowed.shape[0]) if pack.pdb_match.any() else 0
+    ``tier``: None = legacy auto-pick; "pallas" = the fused kernel (the
+    caller must have checked ``wave_pallas_eligible``); "xla" = the
+    bit-identical jnp twin, unconditionally. The wave ladder forces the
+    tier so a breaker-routed fallback re-runs the SAME wave on the twin
+    instead of re-deciding."""
+    num_pdbs = pack_num_pdbs(pack)
     b = pods_req.shape[0]
     # power-of-two group buckets: preemption waves arrive at arbitrary
     # sizes, and per-size jit variants each pay a multi-second compile
@@ -484,12 +516,16 @@ def preempt_batch_device(
         npi[:m] = nom_prio
         nn[:m] = nom_node
 
-    use_pallas = (
-        num_pdbs == 0
-        and pack.v_max <= 32
-        and _os.environ.get("KTPU_PALLAS", "1") != "0"
-        and (jax.default_backend() == "tpu" or FORCE_PALLAS_INTERPRET)
-    )
+    if tier is None:
+        use_pallas = wave_pallas_eligible(pack, num_pdbs)
+    elif tier == "pallas":
+        assert wave_pallas_eligible(pack, num_pdbs), (
+            "pallas tier forced for an ineligible wave"
+        )
+        use_pallas = True
+    else:
+        assert tier == "xla", f"unknown preemption tier {tier!r}"
+        use_pallas = False
     if use_pallas:
         from kubernetes_tpu.ops.pallas_preempt import pallas_preempt_solve
         from kubernetes_tpu.tensors.node_tensor import PODS

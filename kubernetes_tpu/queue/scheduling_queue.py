@@ -19,6 +19,7 @@ from kubernetes_tpu.api.types import Pod
 from kubernetes_tpu.framework.interface import PodInfo
 from kubernetes_tpu.queue import events
 from kubernetes_tpu.queue.heap import Heap
+from kubernetes_tpu.utils import metrics
 
 DEFAULT_POD_INITIAL_BACKOFF = 1.0  # seconds
 DEFAULT_POD_MAX_BACKOFF = 10.0
@@ -58,30 +59,54 @@ def _band_priority(pod: Pod) -> int:
 
 
 class _NominatedPodMap:
-    """Reference scheduling_queue.go:720."""
+    """Reference scheduling_queue.go:720.
+
+    Transition accounting lives HERE, at the single point every entry
+    path (explicit nomination, requeue re-install from status, bind
+    clear, node-delete clear) goes through, so
+    ``nominations_set - nominations_cleared`` tracks LIVE nominations:
+    a move X->Y books one clear and one set, a removal books a clear,
+    an idempotent same-node re-install books nothing."""
 
     def __init__(self) -> None:
         self.nominated_pods: Dict[str, List[Pod]] = {}  # node -> pods
         self.nominated_pod_to_node: Dict[str, str] = {}  # uid -> node
 
-    def add(self, pod: Pod, node_name: str) -> None:
-        self.delete(pod)
+    def add(self, pod: Pod, node_name: str) -> Optional[str]:
+        """Returns the PREVIOUS nomination's node (None if there was
+        none)."""
+        prev = self._remove(pod)
         node = node_name or pod.status.nominated_node_name
+        if node != (prev or ""):
+            if prev:
+                metrics.nominations_cleared.inc()
+            if node:
+                metrics.nominations_set.inc()
         if not node:
-            return
+            return prev
         self.nominated_pod_to_node[pod.metadata.uid] = node
         self.nominated_pods.setdefault(node, []).append(pod)
+        return prev
 
-    def delete(self, pod: Pod) -> None:
+    def delete(self, pod: Pod) -> Optional[str]:
+        """Returns the node the pod WAS nominated to (None when it held
+        no nomination)."""
+        node = self._remove(pod)
+        if node is not None:
+            metrics.nominations_cleared.inc()
+        return node
+
+    def _remove(self, pod: Pod) -> Optional[str]:
         node = self.nominated_pod_to_node.pop(pod.metadata.uid, None)
         if node is None:
-            return
+            return None
         pods = self.nominated_pods.get(node, [])
         self.nominated_pods[node] = [
             p for p in pods if p.metadata.uid != pod.metadata.uid
         ]
         if not self.nominated_pods[node]:
             del self.nominated_pods[node]
+        return node
 
     def pods_for_node(self, node_name: str) -> List[Pod]:
         return list(self.nominated_pods.get(node_name, []))
@@ -621,6 +646,20 @@ class PriorityQueue:
                 return
             for pod in pods:
                 self.nominated_pods.delete(pod)
+
+    def clear_nominations_for_node(self, node_name: str) -> List[Pod]:
+        """Clear every nomination pointing at ``node_name`` -- the node
+        was deleted, so its reservations are claims on capacity that no
+        longer exists (the next batch's overlay and the host oracle's
+        _add_nominated_pods must stop seeing them). Returns the affected
+        pods; the caller re-arms them (moves them to active/backoff) so
+        they re-plan instead of waiting out their backoff against a
+        phantom nomination."""
+        with self._lock:
+            pods = self.nominated_pods.pods_for_node(node_name)
+            for p in pods:
+                self.nominated_pods.delete(p)
+        return pods
 
     def all_nominated_pods_by_node(self) -> Dict[str, List[Pod]]:
         """Locked snapshot of the nominated map (node -> pods); the batch

@@ -60,12 +60,24 @@ class FaultPoint:
     #: spot-reclamation storm: a whole slice of the fleet is deleted at
     #: once (mass requeue + re-solve), cold replacements join later
     RECLAIM_STORM = "reclaim_storm"
+    #: the device victim-search dispatch of a preemption wave raises
+    #: (compile blowup / serving-link error during the wave); the wave's
+    #: solver ladder must charge the tier's breaker and complete on the
+    #: jnp twin (or the host oracle at the floor)
+    PREEMPT_SOLVE = "preempt_solve"
+    #: an evicted victim refuses to die promptly: the delete becomes a
+    #: GRACEFUL eviction (deletion_timestamp set, capacity still held)
+    #: and the real delete lands only after ``hang_seconds`` of grace --
+    #: nominees retrying against the still-occupied node must back off
+    #: via podEligibleToPreemptOthers' terminating-victim check instead
+    #: of re-evicting the same incarnation
+    VICTIM_SLOW_DEATH = "victim_slow_death"
 
     ALL = (
         DEVICE_SOLVE, DEVICE_SOLVE_HANG, SOLVE_GARBAGE, BIND_CONFLICT,
         WATCH_DROP, LEASE_RENEW_FAIL, API_UNAVAILABLE,
         CRASH_BETWEEN_ASSUME_AND_BIND, WATCH_HISTORY_TRUNCATED,
-        NODE_FLAP, RECLAIM_STORM,
+        NODE_FLAP, RECLAIM_STORM, PREEMPT_SOLVE, VICTIM_SLOW_DEATH,
     )
 
 
@@ -292,6 +304,28 @@ def builtin_profiles() -> Dict[str, FaultProfile]:
                 FaultPoint.BIND_CONFLICT: PointConfig(rate=1.0, max_fires=2),
                 FaultPoint.API_UNAVAILABLE: PointConfig(
                     rate=0.03, max_fires=6
+                ),
+            },
+        ),
+        # batched preemption chaos (PR-11 acceptance shape): wave-solve
+        # faults force the pallas tier's breaker through a fallback to
+        # the jnp twin mid-wave, a bind-conflict burst races the
+        # nominees' commits, and slow-dying victims hold their capacity
+        # past the wave so nominees must ride the terminating-victim
+        # re-arm path -- all bounded so a priority-inversion storm still
+        # converges to 100% of the high band bound with zero PDB
+        # overspend
+        "preemption-chaos": FaultProfile(
+            name="preemption-chaos",
+            seed=0,
+            points={
+                FaultPoint.PREEMPT_SOLVE: PointConfig(rate=0.3, max_fires=6),
+                FaultPoint.DEVICE_SOLVE: PointConfig(rate=0.05, max_fires=2),
+                # ONE conflict: absorbed by the default 2-attempt bind
+                # retry (same rationale as lifecycle-chaos)
+                FaultPoint.BIND_CONFLICT: PointConfig(rate=1.0, max_fires=1),
+                FaultPoint.VICTIM_SLOW_DEATH: PointConfig(
+                    rate=0.5, max_fires=8, hang_seconds=0.3
                 ),
             },
         ),

@@ -376,6 +376,56 @@ def add_all_event_handlers(
         if coord is not None and not coord.owns_node(node.metadata.name):
             return
         sched.cache.remove_node(node)
+        # a nomination pointing at the dead node is a reservation on
+        # capacity that no longer exists: clear it (or the next batch's
+        # nominee overlay and the host oracle keep honoring a phantom
+        # claim) and RE-ARM the nominees -- move them to active so they
+        # re-plan now instead of waiting out a backoff for a node that
+        # will never come back under that incarnation
+        clear = getattr(sched.queue, "clear_nominations_for_node", None)
+        if clear is not None:
+            orphaned = clear(node.metadata.name)
+            if orphaned:
+                # also clear the API-side status: the queue map
+                # re-installs a nomination from
+                # status.nominated_node_name on every re-add/update
+                # echo, which would resurrect the phantom reservation
+                # the moment any update of the pod lands (and suppress
+                # scheduling onto a same-name cold replacement node).
+                # The write's own echo may re-add a pod parked for a
+                # deferred wave to the activeQ early -- that is the
+                # standard status-write wake, absorbed by the existing
+                # requeue paths (add_unschedulable_if_not_present's
+                # KeyError and the flush's bound-pod skip), and waking
+                # the nominee to re-plan is exactly the point here
+                client = getattr(sched, "client", None)
+                dead = node.metadata.name
+
+                def _clear_nom(q: Pod) -> None:
+                    # conditional on the AUTHORITATIVE object (the map's
+                    # pod copy can lag its own status-write echo across
+                    # informer kinds), and only for the dead node -- a
+                    # newer nomination elsewhere must stand
+                    if q.status.nominated_node_name == dead:
+                        q.status.nominated_node_name = ""
+
+                for p in orphaned:
+                    if client is None:
+                        continue
+                    try:
+                        client.update_pod_status(
+                            p.metadata.namespace, p.metadata.name,
+                            _clear_nom,
+                        )
+                    except KeyError:
+                        pass  # pod gone: nothing to resurrect from
+                    except Exception:
+                        logger.exception(
+                            "clearing nominatedNodeName for %s", p.key()
+                        )
+                sched.queue.move_all_to_active_or_backoff_queue(
+                    events.NodeDelete
+                )
 
     nodes.add_event_handler(
         ResourceEventHandler(

@@ -27,11 +27,24 @@ from kubernetes_tpu.framework.interface import (
     FitError,
     StatusCode,
 )
+from kubernetes_tpu.robustness.faults import FaultPoint, get_injector
+from kubernetes_tpu.robustness.ladder import (
+    TIER_PALLAS,
+    TIER_XLA,
+    LadderExhausted,
+    SolverLadder,
+)
 from kubernetes_tpu.utils import metrics
 
 logger = logging.getLogger(__name__)
 
 _MAX_INT32 = (1 << 31) - 1
+#: wave priority used by drain PLANNING: below every real pod priority,
+#: so the victim search degenerates into pure fit + nomination carry
+_PLAN_PRIO = -(1 << 31) + 1
+#: the wave tier name for the host-oracle floor (the device tiers are
+#: TIER_PALLAS / TIER_XLA from the shared ladder vocabulary)
+TIER_HOST = "host"
 
 
 def pod_start_time(pod: Pod) -> float:
@@ -158,10 +171,25 @@ class Preemptor:
         "NodeResourcesNumaAligned",
     })
 
-    def __init__(self, algorithm, queue, client) -> None:
+    def __init__(
+        self, algorithm, queue, client, disruption=None, ladder=None
+    ) -> None:
         self.algorithm = algorithm  # GenericScheduler (snapshot + filters)
         self.queue = queue
         self.client = client
+        # the shared voluntary-disruption gate (DisruptionController):
+        # when wired, EVERY wave victim's eviction spends a PDB unit
+        # through can_disrupt -- concurrent waves, drains, and taint
+        # evictions contend on one budget and can never overspend it.
+        # A denied victim set refunds the attempt's grants and the
+        # preemptor requeues without a nomination.
+        self.disruption = disruption
+        # the wave's solver ladder (PR-10 shape): pallas tier -> jnp
+        # twin, each behind its breaker + watchdog; exhaustion falls to
+        # the per-pod host oracle. Own instance by default so wave
+        # faults never poison the batch solver's breakers; new_scheduler
+        # mirrors the batch robustness config in.
+        self.ladder = ladder if ladder is not None else SolverLadder()
         # device victim-search state (stage-7): tensors cached per
         # snapshot generation so a burst of failed pods packs once
         from kubernetes_tpu.tensors import NodeTensorCache
@@ -175,6 +203,27 @@ class Preemptor:
         self._last_adims = None
         self.device_preemptions = 0
         self.host_preemptions = 0
+        # wave observability (bench solver labels + perf-matrix
+        # DataItems). victims_by_tier books what actually HAPPENED: a
+        # victim counts only after its eviction transaction landed, so
+        # a wave aborted by a breaker, a fence, or a denied budget books
+        # nothing (the PR-5 rule).
+        self.waves = 0
+        self.victims_by_tier: Dict[str, int] = {}
+        self.budget_denials = 0
+        self.victims_slow_death = 0
+        self.wave_solver_tier = ""
+        # drain planning reads CURRENT cache truth through a private
+        # snapshot (the scheduler's own snapshot is pre-batch: it lags
+        # the newest commits by one dispatch, and an idle scheduler
+        # never refreshes it); update_snapshot holds the cache lock, so
+        # refreshing it races nothing. The sibling tensor cache persists
+        # with it so a drain's round-after-round re-plans pay
+        # O(changed rows), not a full repack per call.
+        self._plan_snapshot = None
+        self._plan_nt_cache = None
+        self._plan_pack = None
+        self._plan_pack_key = None
 
     # -- eligibility --------------------------------------------------------
 
@@ -325,50 +374,99 @@ class Preemptor:
         return True
 
     def _device_answers(
-        self, pods: List[Pod], potentials, pdbs
-    ) -> List[Tuple[str, List[Pod], int]]:
+        self, pods: List[Pod], potentials, pdbs, prio_override=None,
+        snapshot=None,
+    ) -> Tuple[List[Tuple[str, List[Pod], int]], str]:
         """Stage-7 device victim search (ops/preemption.py) for a group
         of failed pods in priority-desc order, ONE device round trip: the
         kernel's pod scan carries each nomination so later pods see
-        earlier ones (addNominatedPods semantics). Returns one
-        (node_name, victims, num_violating) per pod ("" = no candidate).
+        earlier ones (addNominatedPods semantics). Returns (answers,
+        tier) -- one (node_name, victims, num_violating) per pod ("" =
+        no candidate) plus the solver tier that produced them.
+
+        The solve routes down the wave LADDER: the fused Pallas tier
+        when ``wave_pallas_eligible`` says so, then the bit-identical
+        jnp twin -- each behind its circuit breaker and the watchdog, so
+        a faulted/hung pallas wave is charged to its breaker and the
+        SAME wave completes on the twin. Both tiers exhausted raises
+        LadderExhausted; preempt_batch then takes the per-pod host
+        oracle.
 
         ``potentials``: per-pod iterable of candidate NodeInfos (already
-        pruned of UnschedulableAndUnresolvable nodes)."""
+        pruned of UnschedulableAndUnresolvable nodes).
+        ``prio_override``: replace every pod's wave priority (the drain
+        planner passes _PLAN_PRIO so no victim is ever eligible).
+        ``snapshot``: solve against this snapshot instead of the
+        algorithm's (the drain planner's cache-fresh private one)."""
         import numpy as np
 
         from kubernetes_tpu.ops.host_masks import static_mask_compact
         from kubernetes_tpu.ops.preemption import (
+            pack_num_pdbs,
             pack_preemption_state,
             preempt_batch_device,
             victims_for_node,
+            wave_pallas_eligible,
         )
         from kubernetes_tpu.tensors import pack_pod_batch
 
-        snapshot = self.algorithm.snapshot
-        # the interners inside dims/topology are check-then-insert; the
-        # prewarm thread updates a sibling cache sharing them
-        with self._nt_lock:
-            nt = self._tensor_cache.update(snapshot)
-        key = self._pack_cache_key(snapshot, pdbs)
         from kubernetes_tpu.utils import timeline as _tl
-        with _tl.span("pack_wait"), self._pack_cv:
-            # a prewarm in flight is about to deliver this exact pack:
-            # wait for it instead of duplicating ~0.3s of packing work
-            deadline = time.monotonic() + 2.0
-            while (
-                self._prewarm_busy
-                and self._pack_key != key
-                and time.monotonic() < deadline
-            ):
-                self._pack_cv.wait(0.05)
-            pack = self._pack if self._pack_key == key else None
-        if pack is None:
-            with _tl.span("pack_build"):
-                pack = pack_preemption_state(snapshot, nt, pdbs)
-            with self._pack_cv:
-                self._pack = pack
-                self._pack_key = key
+
+        if snapshot is not None:
+            # private-snapshot path (drain planning): a PERSISTENT
+            # sibling tensor cache sharing the dims/topology interners,
+            # and a private pack -- the shared _tensor_cache/_pack may
+            # be mid-wave on the committing thread with the MAIN
+            # snapshot, and the two snapshots must never thrash one
+            # cache's slot layout. Persisting the sibling keeps a
+            # drain's round-after-round re-plans O(changed rows).
+            from kubernetes_tpu.tensors import NodeTensorCache
+
+            with self._nt_lock:
+                if self._plan_nt_cache is None:
+                    self._plan_nt_cache = NodeTensorCache(
+                        dims=self._tensor_cache.dims,
+                        topology_encoder=self._tensor_cache.topology,
+                    )
+                nt = self._plan_nt_cache.update(snapshot)
+            # pack cached on (generation, pdbs) like the main path: an
+            # unprogressing drain re-plans every poll tick against an
+            # UNCHANGED snapshot, and a ~0.3s pack build per 20ms poll
+            # would turn budget-blocked pacing into a busy loop
+            key = self._pack_cache_key(snapshot, pdbs)
+            pack = (
+                self._plan_pack if self._plan_pack_key == key else None
+            )
+            if pack is None:
+                with _tl.span("pack_build"):
+                    pack = pack_preemption_state(snapshot, nt, pdbs)
+                self._plan_pack = pack
+                self._plan_pack_key = key
+        else:
+            snapshot = self.algorithm.snapshot
+            # the interners inside dims/topology are check-then-insert;
+            # the prewarm thread updates a sibling cache sharing them
+            with self._nt_lock:
+                nt = self._tensor_cache.update(snapshot)
+            key = self._pack_cache_key(snapshot, pdbs)
+            with _tl.span("pack_wait"), self._pack_cv:
+                # a prewarm in flight is about to deliver this exact
+                # pack: wait for it instead of duplicating ~0.3s of
+                # packing work
+                deadline = time.monotonic() + 2.0
+                while (
+                    self._prewarm_busy
+                    and self._pack_key != key
+                    and time.monotonic() < deadline
+                ):
+                    self._pack_cv.wait(0.05)
+                pack = self._pack if self._pack_key == key else None
+            if pack is None:
+                with _tl.span("pack_build"):
+                    pack = pack_preemption_state(snapshot, nt, pdbs)
+                with self._pack_cv:
+                    self._pack = pack
+                    self._pack_key = key
         n = len(pack.node_names)
         b = len(pods)
 
@@ -445,21 +543,48 @@ class Preemptor:
         else:
             nom_req = np.zeros((0, nt.dims.num_dims), dtype=np.int32)
 
+        if prio_override is not None:
+            wave_prio = np.full(b, prio_override, dtype=np.int32)
+        else:
+            wave_prio = np.clip(
+                [p.spec.priority for p in pods], -(1 << 31), (1 << 31) - 2
+            ).astype(np.int32)
+
+        def _tier_thunk(tier_name):
+            def run():
+                inj = get_injector()
+                if inj is not None:
+                    inj.raise_maybe(FaultPoint.PREEMPT_SOLVE)
+                return preempt_batch_device(
+                    pack,
+                    batch.requests,
+                    wave_prio,
+                    None,
+                    nom_req,
+                    np.array(nom_prio, dtype=np.int32),
+                    np.array(nom_node, dtype=np.int32),
+                    cand_dedup=(np.stack(cand_rows), cand_index),
+                    tier=tier_name,
+                )
+
+            return run
+
+        attempts = []
+        if wave_pallas_eligible(pack, pack_num_pdbs(pack)):
+            attempts.append((TIER_PALLAS, _tier_thunk("pallas")))
+        attempts.append((TIER_XLA, _tier_thunk("xla")))
         _span = _tl.span("preempt_device")
         _span.__enter__()
-        chosen, victims, viol, nviol = preempt_batch_device(
-            pack,
-            batch.requests,
-            np.clip(
-                [p.spec.priority for p in pods], -(1 << 31), (1 << 31) - 2
-            ).astype(np.int32),
-            None,
-            nom_req,
-            np.array(nom_prio, dtype=np.int32),
-            np.array(nom_node, dtype=np.int32),
-            cand_dedup=(np.stack(cand_rows), cand_index),
-        )
-        _span.__exit__(None, None, None)
+        try:
+            tier, (chosen, victims, viol, nviol) = self.ladder.run(
+                attempts, label="preempt_wave"
+            )
+        finally:
+            _span.__exit__(None, None, None)
+        if prio_override is None:
+            # a drain PLAN's solve must not relabel the eviction ledger
+            # a concurrent preempt() is about to book against
+            self.wave_solver_tier = tier
         if getattr(pack, "last_adims", None) is not None:
             self._last_adims = pack.last_adims
         out = []
@@ -475,7 +600,7 @@ class Preemptor:
                     int(nviol[k]),
                 )
             )
-        return out
+        return out, tier
 
     def _pack_cache_key(self, snapshot, pdbs):
         return (
@@ -567,20 +692,26 @@ class Preemptor:
 
     def _find_preemption_device(
         self, pod: Pod, potential, pdbs
-    ) -> Optional[Tuple[str, List[Pod], int]]:
-        """Single-pod wrapper over the batched device search."""
-        return self._device_answers([pod], [potential], pdbs)[0]
+    ) -> Tuple[Optional[Tuple[str, List[Pod], int]], str]:
+        """Single-pod wrapper over the batched device search: returns
+        (answer, tier). Raises LadderExhausted when both device tiers
+        are down; the caller falls to the host oracle."""
+        answers, tier = self._device_answers([pod], [potential], pdbs)
+        return answers[0], tier
 
     def find_preemption(
         self, prof, state: CycleState, pod: Pod, fit_err: FitError
-    ) -> Tuple[str, List[Pod], List[Pod]]:
-        """generic_scheduler.go:270 Preempt. Returns
-        (node_name, victims, nominated_pods_to_clear)."""
+    ) -> Tuple[str, List[Pod], List[Pod], str]:
+        """generic_scheduler.go:270 Preempt. Returns (node_name,
+        victims, nominated_pods_to_clear, solver_tier) -- the tier is
+        plumbed through the return (not an instance attribute) so a
+        concurrent drain plan or wave on another thread cannot relabel
+        this preemption's eviction booking."""
         if not self.pod_eligible_to_preempt_others(pod):
-            return "", [], []
+            return "", [], [], TIER_HOST
         potential = self.nodes_where_preemption_might_help(fit_err)
         if not potential:
-            return "", [], [pod]  # clear any stale nomination
+            return "", [], [pod], TIER_HOST  # clear any stale nomination
         pdbs = []
         if self.client is not None:
             try:
@@ -588,17 +719,29 @@ class Preemptor:
             except Exception:
                 logger.exception("listing PDBs")
         if self.device_eligible(prof, pod):
-            result = self._find_preemption_device(pod, potential, pdbs)
+            try:
+                result, tier = self._find_preemption_device(
+                    pod, potential, pdbs
+                )
+            except LadderExhausted:
+                # both device tiers down: the host oracle below is the
+                # wave floor (counted as a host preemption)
+                logger.warning(
+                    "device preemption tiers exhausted for %s; "
+                    "falling to the host oracle", pod.key(),
+                )
+                result = None
             if result is not None:
                 self.device_preemptions += 1
                 node_name, victims, _ = result
                 if not node_name:
-                    return "", [], []
+                    return "", [], [], tier
                 nominated_to_clear = self._lower_priority_nominated_pods(
                     pod, node_name
                 )
-                return node_name, victims, nominated_to_clear
+                return node_name, victims, nominated_to_clear, tier
         self.host_preemptions += 1
+        self.wave_solver_tier = TIER_HOST
         nodes_to_victims: Dict[str, Victims] = {}
         for ni in potential:
             victims, num_violating, fits = self.select_victims_on_node(
@@ -618,9 +761,12 @@ class Preemptor:
                 )
         node_name = pick_one_node_for_preemption(nodes_to_victims)
         if node_name is None:
-            return "", [], []
+            return "", [], [], TIER_HOST
         nominated_to_clear = self._lower_priority_nominated_pods(pod, node_name)
-        return node_name, nodes_to_victims[node_name].pods, nominated_to_clear
+        return (
+            node_name, nodes_to_victims[node_name].pods,
+            nominated_to_clear, TIER_HOST,
+        )
 
     def _lower_priority_nominated_pods(
         self, pod: Pod, node_name: str
@@ -691,52 +837,393 @@ class Preemptor:
             potentials.append(potential)
         if not live_pods:
             return results, []
-        answers = self._device_answers(live_pods, potentials, pdbs)
-        self.device_preemptions += len(live_pods)
-        all_victims = {}
+        try:
+            answers, tier = self._device_answers(
+                live_pods, potentials, pdbs
+            )
+            self.device_preemptions += len(live_pods)
+        except LadderExhausted:
+            # both device tiers down (breakers open / faults exhausted
+            # the retries): the wave still completes on the per-pod host
+            # oracle with the nomination fold through the queue
+            logger.warning(
+                "preemption wave device tiers exhausted; running the "
+                "host-oracle floor for %d pods", len(live_pods),
+            )
+            answers = self._host_wave_answers(
+                prof,
+                [(pod, items[k][1]) for k, pod in zip(live, live_pods)],
+                pdbs,
+            )
+            tier = TIER_HOST
+            self.host_preemptions += len(live_pods)
+        self.wave_solver_tier = tier
+        self.waves += 1
+        metrics.preemption_waves.inc()
+        all_victims: Dict[str, Pod] = {}
+        spent: Dict[str, Pod] = {}  # uid -> victim with a granted PDB unit
         for k, pod, (node_name, victims, _) in zip(
             live, live_pods, answers
         ):
             metrics.preemption_attempts.inc()
-            if node_name:
+            if not node_name:
+                continue
+            if self.disruption is not None and victims:
+                taken = self._charge_victims(
+                    victims,
+                    already_paid=all_victims.keys() | spent.keys(),
+                )
+                if taken is None:
+                    # denied: skip the nomination (evicting a partial
+                    # victim set frees too little for the preemptor to
+                    # fit). The host-oracle floor pre-folds nominations
+                    # into the queue so later wave pods see them -- a
+                    # denied pod's fold must come OUT again or it
+                    # stands as a phantom reservation (no-op on the
+                    # device tiers, which nominate only in
+                    # _apply_preemption below)
+                    if self.queue is not None:
+                        self.queue.delete_nominated_pod_if_exists(pod)
+                    continue
+                for g in taken:
+                    spent[g.metadata.uid] = g
+            if self._apply_preemption(
+                prof, pod, node_name, victims,
+                delete_victims=False, write_status=False,
+            ) is not None:
                 metrics.preemption_victims.observe(len(victims))
-                if self._apply_preemption(
-                    prof, pod, node_name, victims,
-                    delete_victims=False, write_status=False,
-                ):
-                    results[k] = node_name
-                    for v in victims:
-                        all_victims[v.metadata.uid] = v
+                results[k] = node_name
+                for v in victims:
+                    all_victims[v.metadata.uid] = v
+            elif self.disruption is not None:
+                # defensive: with write_status=False _apply_preemption
+                # currently has no failing path, but any failure mode it
+                # grows must give back grants no other successful
+                # preemptor shares -- a silent budget leak here would
+                # only surface as drains starving much later
+                for v in victims:
+                    uid = v.metadata.uid
+                    if uid in spent and uid not in all_victims:
+                        self.disruption.refund_disruption(spent.pop(uid))
         # one eviction transaction for the whole group (victims chosen
         # by several pods dedup by uid; deletion is idempotent)
         if all_victims:
-            evicted = True
-            if self.client is not None:
+            evicted_now = self._evict_victims(all_victims, tier)
+            if evicted_now is None:
+                # eviction failed: nominations stand but the cluster is
+                # unchanged -- refund every grant this wave spent (the
+                # budget must track what actually happened), and make
+                # callers requeue WITH backoff (None sentinel), or the
+                # nominees hot-loop a full wave + eviction attempt
+                # against a persistent API failure
+                if self.disruption is not None:
+                    for v in spent.values():
+                        self.disruption.refund_disruption(v)
+                return results, None
+            for v in all_victims.values():
+                waiting = prof.get_waiting_pod(v.metadata.uid)
+                if waiting is not None:
+                    waiting.reject("preemption", "preempted")
+            return results, evicted_now
+        return results, []
+
+    def _charge_victims(
+        self, victims: List[Pod], already_paid=frozenset()
+    ) -> Optional[List[Pod]]:
+        """All-or-nothing spend of ONE preemptor's victim set through
+        the shared can_disrupt gate: concurrent waves, drains, and
+        taint evictions contend on the same counters, so a stale
+        kernel answer can never overspend. Returns the newly granted
+        victims, or None on deny -- with every grant this attempt took
+        refunded (evicting a partial set would strand spent budget)
+        and the denial counted. No denial memo across attempts: a
+        failed preemptor's refund re-opens the budget, so a victim
+        denied for pod A may legitimately be granted to pod B -- every
+        check goes to the authoritative counter.
+
+        ``already_paid``: victim uids an earlier successful preemptor
+        in the same wave already spent for (shared victims dedup by
+        uid; deletion is idempotent)."""
+        taken: List[Pod] = []
+        for v in victims:
+            if v.metadata.uid in already_paid:
+                continue
+            if not self.disruption.can_disrupt(v):
+                for g in taken:
+                    self.disruption.refund_disruption(g)
+                self.budget_denials += 1
+                metrics.preemption_budget_denials.inc()
+                return None
+            taken.append(v)
+        return taken
+
+    def _evict_victims(
+        self, all_victims: Dict[str, Pod], tier: str
+    ) -> Optional[List[str]]:
+        """One bulk eviction for a wave's deduplicated victims. Returns
+        the uids whose delete landed PROMPTLY (the caller's
+        cache-propagation wait list), or None on transaction failure
+        (nothing was evicted; the caller refunds the budget).
+
+        Victims the VICTIM_SLOW_DEATH fault selects die gracefully
+        instead: marked terminating now (deletion_timestamp -- so
+        pod_eligible_to_preempt_others sees a terminating victim and
+        nominees re-arm instead of re-evicting) but holding capacity
+        until the grace timeout delivers the real, uid-fenced delete.
+
+        Victim counters book HERE, after the transaction: a wave
+        aborted earlier (breaker, fence, denied budget, apply rollback)
+        has booked nothing."""
+        inj = get_injector()
+        slow: List[Pod] = []
+        prompt: List[Pod] = []
+        for v in all_victims.values():
+            if inj is not None and inj.should_fire(
+                FaultPoint.VICTIM_SLOW_DEATH
+            ):
+                slow.append(v)
+            else:
+                prompt.append(v)
+        evicted_prompt: List[Pod] = list(prompt)
+        slow_started = 0
+        if self.client is not None:
+            if prompt:
+                missing: List[Tuple[str, str]] = []
                 try:
                     self.client.delete_pods_bulk(
                         [
                             (v.metadata.namespace, v.metadata.name)
-                            for v in all_victims.values()
-                        ]
+                            for v in prompt
+                        ],
+                        missing_out=missing,
                     )
                 except Exception:
                     # nominations stand (they self-heal on the pods'
                     # retries), but waiting victims must NOT be rejected
                     # for an eviction that never happened
                     logger.exception("bulk victim eviction")
-                    evicted = False
-            if not evicted:
-                # eviction failed: nominations stand but the cluster is
-                # unchanged -- callers must requeue WITH backoff (None
-                # sentinel), or the nominees hot-loop a full wave +
-                # eviction attempt against a persistent API failure
-                return results, None
-            for v in all_victims.values():
-                waiting = prof.get_waiting_pod(v.metadata.uid)
-                if waiting is not None:
-                    waiting.reject("preemption", "preempted")
-            return results, list(all_victims.keys())
-        return results, []
+                    return None
+                if missing:
+                    # a concurrent disruption path got there first: OUR
+                    # grant evicted nothing for these -- refund and
+                    # UN-BOOK them (the invariant every other eviction
+                    # path holds: counters record what actually
+                    # happened)
+                    gone = set(missing)
+                    evicted_prompt = []
+                    for v in prompt:
+                        key = (v.metadata.namespace, v.metadata.name)
+                        if key in gone:
+                            if self.disruption is not None:
+                                self.disruption.refund_disruption(v)
+                        else:
+                            evicted_prompt.append(v)
+            grace = 0.25
+            if inj is not None:
+                cfg = inj.point_config(FaultPoint.VICTIM_SLOW_DEATH)
+                if cfg is not None and cfg.hang_seconds:
+                    grace = cfg.hang_seconds
+            for v in slow:
+                if self._slow_death(v, grace):
+                    slow_started += 1
+                elif self.disruption is not None:
+                    # already gone / name reclaimed: same refund as the
+                    # prompt path's missing report
+                    self.disruption.refund_disruption(v)
+        else:
+            slow_started = len(slow)
+        n = len(evicted_prompt) + slow_started
+        if n:
+            metrics.victims_selected.inc(n, tier=tier)
+            self.victims_by_tier[tier] = (
+                self.victims_by_tier.get(tier, 0) + n
+            )
+        self.victims_slow_death += slow_started
+        return [v.metadata.uid for v in evicted_prompt]
+
+    def _slow_death(self, victim: Pod, grace: float) -> bool:
+        """Graceful eviction under the VICTIM_SLOW_DEATH fault: mark the
+        pod terminating NOW, deliver the real delete after ``grace``
+        seconds. Both the mark and the delayed delete are uid-FENCED --
+        a respawned incarnation that reclaimed the name is neither
+        stamped terminating nor killed by the old timer, which is what
+        keeps eviction exactly-once per pod incarnation under chaos.
+        Returns False when the victim was ALREADY gone (the caller
+        refunds its grant and un-books it, like the prompt path's
+        missing report)."""
+        ns = victim.metadata.namespace
+        name = victim.metadata.name
+        uid = victim.metadata.uid
+        marked = {}
+
+        def mark(p: Pod) -> None:
+            if p.metadata.uid != uid:
+                return  # a fresh incarnation took the name: not ours
+            marked["ok"] = True
+            if p.metadata.deletion_timestamp is None:
+                p.metadata.deletion_timestamp = time.time()
+
+        try:
+            self.client.server.guaranteed_update("Pod", ns, name, mark)
+        except KeyError:
+            return False  # already gone: nothing was evicted
+        except Exception:
+            logger.exception("marking slow-death victim %s/%s", ns, name)
+        if not marked.get("ok"):
+            return False  # name reclaimed by a new incarnation
+
+        def finish() -> None:
+            # uid-PRECONDITIONED delete, checked atomically under the
+            # apiserver store lock: a read-then-delete would race a
+            # concurrent evict+respawn and kill the fresh incarnation
+            from kubernetes_tpu.apiserver.server import Conflict
+
+            try:
+                self.client.server.delete(
+                    "Pod", ns, name, expect_uid=uid
+                )
+            except KeyError:
+                pass  # already gone
+            except Conflict:
+                pass  # a fresh incarnation took the name: never kill it
+            except Exception:
+                logger.exception("slow-death delete for %s/%s", ns, name)
+
+        t = threading.Timer(grace, finish)
+        t.daemon = True
+        t.start()
+        return True
+
+    def _host_wave_answers(
+        self, prof, live_items: List[Tuple[Pod, FitError]], pdbs
+    ) -> List[Tuple[str, List[Pod], int]]:
+        """The wave floor: the per-pod host oracle run in wave order
+        with the nomination fold through the QUEUE -- each pod's filter
+        pass virtually adds every earlier pod via _add_nominated_pods
+        (generic_scheduler.go:535), the same view the device kernel's
+        carry provides. Only reached when both device tiers are down."""
+        from kubernetes_tpu.scheduler.generic import SNAPSHOT_STATE_KEY
+
+        out: List[Tuple[str, List[Pod], int]] = []
+        snapshot = self.algorithm.snapshot
+        for pod, fit_err in live_items:
+            state = CycleState()
+            state.write(SNAPSHOT_STATE_KEY, snapshot)
+            try:
+                prof.run_pre_filter_plugins(state, pod)
+            except Exception:
+                logger.exception("host wave prefilter for %s", pod.key())
+                out.append(("", [], 0))
+                continue
+            potential = self.nodes_where_preemption_might_help(fit_err)
+            nodes_to_victims: Dict[str, Victims] = {}
+            for ni in potential:
+                victims, num_violating, fits = self.select_victims_on_node(
+                    prof, state, pod, ni, pdbs
+                )
+                if fits:
+                    nodes_to_victims[ni.node_name] = Victims(
+                        victims, num_violating
+                    )
+            node_name = pick_one_node_for_preemption(nodes_to_victims)
+            if node_name is None:
+                out.append(("", [], 0))
+                continue
+            chosen = nodes_to_victims[node_name]
+            out.append((node_name, chosen.pods, chosen.num_pdb_violations))
+            if self.queue is not None:
+                # fold the nomination so later wave pods see it;
+                # _apply_preemption re-installs it idempotently
+                self.queue.update_nominated_pod_for_node(pod, node_name)
+        return out
+
+    # -- drain planning (NodeDrainer.drain_via_preemption) -------------------
+
+    def plan_eligible(self, pod: Pod) -> bool:
+        """True when the resource-fit + static-mask model answers
+        replacement feasibility EXACTLY for this pod. The subset of
+        device_eligible that needs no Framework at hand (drain planning
+        runs outside a scheduling cycle); pods that fail it take the
+        classic unconditional eviction path."""
+        from kubernetes_tpu.api.types import POD_GROUP_LABEL
+        from kubernetes_tpu.scheduler.batch import solver_supported
+
+        if not solver_supported(pod):
+            return False
+        if any(v.pvc_claim_name for v in pod.spec.volumes):
+            return False
+        if pod.spec.topology_spread_constraints:
+            return False
+        if pod_host_ports(pod):
+            return False
+        a = pod.spec.affinity
+        if a is not None and (
+            a.pod_affinity is not None or a.pod_anti_affinity is not None
+        ):
+            return False
+        if pod.metadata.labels.get(POD_GROUP_LABEL):
+            return False
+        return True
+
+    def plan_replacements(
+        self, pods: List[Pod], exclude_nodes=()
+    ) -> List[str]:
+        """Drain planning: for each pod (usually residents of a cordoned
+        node), a node it could re-place onto RIGHT NOW with free
+        capacity, "" = nowhere -- through the SAME device wave kernel.
+        The wave priority is clamped below every real priority so no
+        victim is ever eligible: a drain plan answers "where does this
+        pod go without cascading more evictions", which degenerates the
+        victim search into pure fit + the nomination carry (each planned
+        pod's claim is visible to the next pod in the plan).
+
+        ``exclude_nodes`` is masked out of every candidate row -- the
+        drained node must never answer for its own pods even when the
+        snapshot has not yet observed its cordon (the unschedulable flag
+        lands with the next dispatch's snapshot update; the plan cannot
+        wait for it). No queue or API side effects -- this is a plan,
+        not a nomination."""
+        if not pods:
+            return []
+        from kubernetes_tpu.ops.affinity import (
+            cluster_has_required_anti_affinity,
+        )
+
+        # plan against CURRENT cache truth through the private snapshot:
+        # the algorithm's snapshot is pre-batch (it lags the newest
+        # commits by one dispatch and an idle scheduler never refreshes
+        # it), and a drain plan made against yesterday's free capacity
+        # evicts pods whose destination is already taken
+        cache = getattr(self.algorithm, "cache", None)
+        if cache is not None:
+            if self._plan_snapshot is None:
+                from kubernetes_tpu.cache.snapshot import Snapshot
+
+                self._plan_snapshot = Snapshot()
+            snapshot = cache.update_snapshot(self._plan_snapshot)
+        else:
+            snapshot = self.algorithm.snapshot
+        if cluster_has_required_anti_affinity(snapshot):
+            # an existing pod's required anti-affinity makes the fit
+            # model inexact for EVERY destination: no plan
+            return [""] * len(pods)
+        exclude = set(exclude_nodes)
+        live = [
+            ni for ni in snapshot.list_node_infos()
+            if ni.node is not None and ni.node_name not in exclude
+        ]
+        # plan the pod's POST-EVICTION incarnation: a pending respawn
+        # clone. Planning the bound pod itself would let the NodeName
+        # model pin its static mask to the very node being drained.
+        from kubernetes_tpu.robustness.lifecycle import respawn_clone
+
+        clones = [respawn_clone(p) for p in pods]
+        potentials = [live] * len(clones)
+        answers, _tier = self._device_answers(
+            clones, potentials, [], prio_override=_PLAN_PRIO,
+            snapshot=snapshot,
+        )
+        return [node_name for node_name, _v, _nv in answers]
 
     def _clear_nomination(self, pod: Pod) -> None:
         self.queue.delete_nominated_pod_if_exists(pod)
@@ -759,12 +1246,16 @@ class Preemptor:
         victims: List[Pod],
         delete_victims: bool = True,
         write_status: bool = True,
-    ) -> bool:
+    ) -> Optional[int]:
         """The API side effects of one successful preemption
         (scheduler.go:392): nominate, delete victims, clear superseded
-        lower-priority nominations. Returns False when the nomination
-        write failed and was rolled back (no victims were evicted) --
-        callers must then report no nomination. ``delete_victims=False``
+        lower-priority nominations. Returns the number of victims whose
+        delete actually LANDED (so the caller books evictions, not
+        proposals; with ``delete_victims=False`` that is len(victims) --
+        the deferred bulk eviction does its own booking), or None when
+        the nomination write failed and was rolled back (no victims
+        were evicted) -- callers must then report no nomination.
+        ``delete_victims=False``
         lets preempt_batch evict the whole group's victims in one
         transaction afterwards. ``write_status=False`` skips the API
         nominatedNodeName write: the batched path defers it to
@@ -787,7 +1278,8 @@ class Preemptor:
             except Exception:
                 logger.exception("setting nominatedNodeName")
                 self.queue.delete_nominated_pod_if_exists(pod)
-                return False
+                return None
+        evicted = 0
         for victim in victims:
             recorder = getattr(prof, "recorder", None)
             if recorder is not None:
@@ -797,14 +1289,21 @@ class Preemptor:
                     f"{pod.metadata.name} on node {node_name}",
                 )
             if not delete_victims:
+                evicted += 1  # deferred bulk eviction books for itself
                 continue
             if self.client is not None:
                 try:
                     self.client.delete_pod(
                         victim.metadata.namespace, victim.metadata.name
                     )
+                    evicted += 1
                 except KeyError:
-                    pass
+                    # already gone: a concurrent disruption path got
+                    # there first, so OUR spent grant evicted nothing
+                    if self.disruption is not None:
+                        self.disruption.refund_disruption(victim)
+            else:
+                evicted += 1
             waiting = prof.get_waiting_pod(victim.metadata.uid)
             if waiting is not None:
                 waiting.reject("preemption", "preempted")
@@ -820,7 +1319,7 @@ class Preemptor:
                     )
                 except Exception:
                     logger.exception("clearing nominatedNodeName")
-        return True
+        return evicted
 
     # -- host-side actions (scheduler.go:392) --------------------------------
 
@@ -834,14 +1333,30 @@ class Preemptor:
                 )
             except KeyError:
                 return ""
-        node_name, victims, to_clear = self.find_preemption(
+        node_name, victims, to_clear, tier = self.find_preemption(
             prof, state, pod, fit_err
         )
         metrics.preemption_attempts.inc()
         if node_name:
+            if self.disruption is not None and victims:
+                # the sequential path spends the same shared PDB budget
+                # as the wave, drains, and taint evictions
+                if self._charge_victims(victims) is None:
+                    return ""
             metrics.preemption_victims.observe(len(victims))
-            if not self._apply_preemption(prof, pod, node_name, victims):
+            evicted = self._apply_preemption(prof, pod, node_name, victims)
+            if evicted is None:
+                if self.disruption is not None:
+                    for v in victims:
+                        self.disruption.refund_disruption(v)
                 return ""  # nomination write failed and was rolled back
+            if evicted:
+                # book what actually happened: victims whose delete
+                # raced a concurrent eviction were refunded, not evicted
+                metrics.victims_selected.inc(evicted, tier=tier)
+                self.victims_by_tier[tier] = (
+                    self.victims_by_tier.get(tier, 0) + evicted
+                )
             return node_name
         # no candidate: clear any stale nomination of the pod itself
         for p in to_clear:

@@ -712,6 +712,14 @@ def new_scheduler(
     from kubernetes_tpu.scheduler.preemption import Preemptor
 
     sched.preemptor = Preemptor(algorithm, queue, client)
+    if batch:
+        # the wave ladder mirrors the batch solver's robustness config
+        # (watchdog/retry/breaker knobs, injectable sleep) with its OWN
+        # breakers: a sick preemption path degrades independently of --
+        # and never poisons -- the main solve tiers
+        from kubernetes_tpu.robustness.ladder import SolverLadder
+
+        sched.preemptor.ladder = SolverLadder(sched.ladder.config)
     sched.event_broadcaster = broadcaster
     add_all_event_handlers(sched, informer_factory)
     # materialize every plugin-consumed informer BEFORE factory start so

@@ -321,6 +321,41 @@ evictions_blocked_by_pdb = registry.register(Counter(
     "shared PodDisruptionBudget gate (DisruptionController."
     "can_disrupt).",
 ))
+# batched preemption waves (PR 11): device-chosen victims, budget-gated
+# evictions, nomination lifecycle -- every wave outcome is counted by
+# what ACTUALLY happened (victims book only after the eviction
+# transaction lands; a wave aborted by a breaker, a fence, or a denied
+# budget books nothing)
+preemption_waves = registry.register(Counter(
+    "scheduler_preemption_waves_total",
+    "Batched device preemption waves run (one per flushed failed-pod "
+    "group per profile).",
+))
+victims_selected = registry.register(Counter(
+    "scheduler_preemption_victims_selected_total",
+    "Victims actually evicted by preemption, by the solver tier that "
+    "chose them (pallas / xla / host). Booked only after the eviction "
+    "transaction succeeds -- an aborted wave un-books nothing because "
+    "nothing was booked.",
+    ("tier",),
+))
+nominations_set = registry.register(Counter(
+    "scheduler_preemption_nominations_set_total",
+    "nominatedNodeName reservations installed in the scheduling queue "
+    "(update_nominated_pod_for_node with a concrete node).",
+))
+nominations_cleared = registry.register(Counter(
+    "scheduler_preemption_nominations_cleared_total",
+    "Nominations removed from the queue map: the nominee bound, was "
+    "superseded, failed terminally, or its nominated node was deleted.",
+))
+preemption_budget_denials = registry.register(Counter(
+    "scheduler_preemption_budget_denials_total",
+    "Preemptors whose victim set was denied by the shared "
+    "DisruptionController.can_disrupt PDB gate (grants taken for the "
+    "attempt are refunded; the preemptor requeues without a "
+    "nomination).",
+))
 node_removed_requeues = registry.register(Counter(
     "scheduler_node_removed_requeues_total",
     "In-flight assumed pods whose node was deleted mid-bind, expired "

@@ -149,12 +149,12 @@ def test_device_matches_host_oracle(seed, with_pdbs):
     state, fit_err = _fail(algorithm, fw, preemptor_pod)
 
     assert preemptor.device_eligible(fw, preemptor_pod)
-    dev = preemptor._find_preemption_device(
+    dev, tier = preemptor._find_preemption_device(
         preemptor_pod,
         preemptor.nodes_where_preemption_might_help(fit_err),
         pdbs,
     )
-    assert dev is not None
+    assert dev is not None and tier in ("pallas", "xla")
     dev_node, dev_victims, _ = dev
     host_node, host_victims = _host_answer(
         preemptor, fw, state, preemptor_pod, fit_err, pdbs
@@ -181,7 +181,7 @@ def test_pdb_budget_ordering_matches_oracle():
         .priority(100).obj()
     )
     state, fit_err = _fail(algorithm, fw, preemptor_pod)
-    dev = preemptor._find_preemption_device(
+    dev, _tier = preemptor._find_preemption_device(
         preemptor_pod,
         preemptor.nodes_where_preemption_might_help(fit_err),
         pdbs,

@@ -32,6 +32,13 @@ Prints ONE JSON line:
    "member_full_repack_ms":
                          the RETIRED pre-PR-6 membership path (full
                          M-row repack), for scale,
+   "preempt_pack_ms" / "preempt_wave_{xla,pallas}_ms":
+                         the ISSUE-11 batched preemption wave at M
+                         nodes: per-snapshot victim pack, then ONE
+                         kernel round trip for a 256-pod failed group
+                         (victim scan + reprieve + 6-rule pick +
+                         nomination carry) on the Pallas tier vs the
+                         jnp twin (pallas is None off-TPU),
    "mesh_delta_scatter_{empty,bucket}_ms" / "mesh_full_upload_ms" /
    "mesh_{delta,full}_link_bytes":
                          the PR-9 mesh serving-link comparison at 20k
@@ -651,6 +658,104 @@ def bench_mesh_pallas(num_nodes: int, mesh_devices: int):
     }
 
 
+def bench_preemption_wave(num_nodes: int, wave: int = 256):
+    """ISSUE-11 satellite: the batched preemption wave's device cost at
+    scale -- the per-snapshot victim pack, then ONE kernel round trip
+    for a whole failed-pod group (remove-all + reprieve simulation over
+    every candidate node x victim, PLUS the in-kernel 6-rule
+    lexicographic pick and the nomination carry) -- Pallas tier vs the
+    bit-identical jnp twin. On non-TPU backends the pallas tier is
+    ineligible (wave_pallas_eligible) and reported as None: interpret
+    mode would time the emulator, not the kernel."""
+    import numpy as np
+
+    from kubernetes_tpu.cache.cache import SchedulerCache
+    from kubernetes_tpu.cache.snapshot import Snapshot
+    from kubernetes_tpu.ops.preemption import (
+        pack_preemption_state,
+        preempt_batch_device,
+        wave_pallas_eligible,
+    )
+    from kubernetes_tpu.tensors import NodeTensorCache, pack_pod_batch
+    from kubernetes_tpu.testing import make_node, make_pod
+
+    cache = SchedulerCache()
+    for i in range(num_nodes):
+        cache.add_node(
+            make_node(f"n{i}")
+            .capacity(cpu="8", memory="32Gi", pods=16)
+            .obj()
+        )
+    t0 = time.time() - 10_000
+    # 4 victims/node at 1.8 cpu each: 800m free, so a 2-cpu preemptor
+    # always needs one eviction per placement
+    for i in range(num_nodes):
+        for j in range(4):
+            p = (
+                make_pod(f"v-{i}-{j}").node(f"n{i}")
+                .container(cpu="1800m", memory="4Gi")
+                .priority(j % 3)
+                .obj()
+            )
+            p.status.start_time = t0 + (i * 7 + j) % 9973
+            cache.add_pod(p)
+    snapshot = Snapshot()
+    cache.update_snapshot(snapshot)
+    nt = NodeTensorCache().update(snapshot)
+
+    t = time.perf_counter()
+    pack = pack_preemption_state(snapshot, nt, [])
+    pack_ms = (time.perf_counter() - t) * 1000
+
+    preemptors = [
+        make_pod(f"hi-{k}").container(cpu="2", memory="4Gi")
+        .priority(100).obj()
+        for k in range(wave)
+    ]
+    batch = pack_pod_batch(preemptors, nt.dims)
+    prio = np.full(wave, 100, dtype=np.int32)
+    # a homogeneous wave shares one all-nodes candidate row (the
+    # production path's dedup shape)
+    rows = np.ones((1, len(pack.node_names)), dtype=bool)
+    inverse = np.zeros(wave, dtype=np.int32)
+    nom_req = np.zeros((0, nt.dims.num_dims), dtype=np.int32)
+    nom_i = np.zeros(0, dtype=np.int32)
+
+    def run(tier):
+        chosen, _v, _viol, _nv = preempt_batch_device(
+            pack, batch.requests, prio, None,
+            nom_req, nom_i, nom_i,
+            cand_dedup=(rows, inverse), tier=tier,
+        )
+        return chosen
+
+    out = {
+        "preempt_nodes": num_nodes,
+        "preempt_wave_pods": wave,
+        "preempt_wave_vmax": pack.v_max,
+        "preempt_pack_ms": pack_ms,
+    }
+    chosen = run("xla")  # compile off the clock
+    assert int((chosen >= 0).sum()) == wave, "wave should fully place"
+    best = float("inf")
+    for _ in range(3):
+        t = time.perf_counter()
+        run("xla")
+        best = min(best, (time.perf_counter() - t) * 1000)
+    out["preempt_wave_xla_ms"] = best
+    if wave_pallas_eligible(pack, 0):
+        run("pallas")
+        best_p = float("inf")
+        for _ in range(3):
+            t = time.perf_counter()
+            run("pallas")
+            best_p = min(best_p, (time.perf_counter() - t) * 1000)
+        out["preempt_wave_pallas_ms"] = best_p
+    else:
+        out["preempt_wave_pallas_ms"] = None
+    return out
+
+
 def bench_watch_fanout(events: int = 20000):
     """Apiserver watch fan-out under N consumers (the partitioned
     control plane runs one full informer set PER STACK): broadcast
@@ -773,6 +878,7 @@ def main() -> None:
     member = bench_membership_churn(args.nodes)
     mesh_delta = bench_mesh_delta(args.mesh_nodes, args.mesh_devices)
     mesh_pallas = bench_mesh_pallas(args.mesh_nodes, args.mesh_devices)
+    preempt = bench_preemption_wave(args.nodes)
     fanout = bench_watch_fanout()
 
     record = {
@@ -808,6 +914,14 @@ def main() -> None:
         {
             k: (v if isinstance(v, (int, bool)) else round(v, 3))
             for k, v in mesh_pallas.items()
+        }
+    )
+    record.update(
+        {
+            k: (
+                v if v is None or isinstance(v, int) else round(v, 3)
+            )
+            for k, v in preempt.items()
         }
     )
     record.update({k: round(v, 2) for k, v in fanout.items()})
