@@ -59,6 +59,21 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BASELINE_PODS_PER_SEC = 30.0  # reference threshold3K
 
 
+def _host_env() -> dict:
+    """Machine-readable run context merged into EVERY payload: the
+    host core count (the --partitions A/B on a 2-core box was
+    core-starved, and the caveat lived only in prose) and whether the
+    native ingest plane actually ran (KTPU_NATIVE_INGEST + build
+    state) -- an A/B against the Python twins is meaningless without
+    the flag recorded."""
+    from kubernetes_tpu import native
+
+    return {
+        "host_cores": os.cpu_count() or 0,
+        "ingest_native": native.ingest_native_active(),
+    }
+
+
 class BindWatcher:
     """Counts bound pods and records bind wall time per pod from a watch
     stream -- the bench-side analogue of the reference throughputCollector
@@ -266,6 +281,7 @@ def run_ha_chaos_bench(fault_seed: int) -> None:
     install_injector(None)
 
     record = {
+        **_host_env(),
         "metric": "ha_chaos_failover_takeover",
         "value": round(takeover_s * 1000, 1),
         "unit": "ms",
@@ -392,6 +408,7 @@ def soak_once(
     sched.stop()
     informers.stop()
     record = {
+        **_host_env(),
         "metric": "soak_slo_violation_minutes",
         "value": round(violated * bucket_s / 60.0, 3),
         "unit": "minutes",
@@ -673,6 +690,7 @@ def run_open_loop_bench(args) -> None:
     headline_policy = "adaptive" if "adaptive" in per_policy else policies[0]
     headline = per_policy[headline_policy]
     record = {
+        **_host_env(),
         "metric": "open_loop_sustained_at_slo",
         "value": headline["sustained_at_slo_pods_per_sec"],
         "unit": "pods/s",
@@ -840,6 +858,7 @@ def run_partitioned_burst(args) -> None:
         return
     median = pick_median_trial(trials)
     record = {
+        **_host_env(),
         "metric": (
             f"pods_per_sec_"
             f"{f'{num_pods//1000}k' if num_pods >= 1000 else num_pods}"
@@ -1170,6 +1189,7 @@ def main() -> None:
     median = pick_median_trial(trials)
     pods_per_sec = median["pods_per_sec"]
     record = {
+        **_host_env(),
         "metric": (
             f"pods_per_sec_"
             f"{f'{num_pods//1000}k' if num_pods >= 1000 else num_pods}"

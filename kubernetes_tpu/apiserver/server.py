@@ -41,7 +41,7 @@ _POD_COW_ATTRS = ("metadata", "spec", "status")
 #: mask signature; arbitrary updates (guaranteed_update's mutate, a
 #: client update) may change anything, so every memo must go.
 _SIG_MEMO = "_sig_memo"
-_ALL_MEMOS = ("_sig_memo", "_hot_memo", "_req_memo", "_nzr_memo")
+_ALL_MEMOS = ("_sig_memo", "_hot_memo", "_req_memo", "_nzr_memo", "_packrow")
 
 
 def _strip_memos(obj: Any) -> None:
@@ -105,6 +105,12 @@ class WatchEvent:
     type: str  # ADDED | MODIFIED | DELETED
     object: Any
     resource_version: int
+    #: decode-once ingest record (the (namespace, name) key), filled
+    #: lazily by the FIRST consumer that walks obj.metadata (native
+    #: ingest_decode/ingest_apply or their Python twins) and shared by
+    #: every later cursor draining the same per-kind event log -- N
+    #: partitioned informer sets decode each apiserver transaction once
+    decoded: Any = None
 
 
 class Watch:

@@ -18,6 +18,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from kubernetes_tpu import native as _native
 from kubernetes_tpu.robustness.faults import FaultPoint, get_injector
 from kubernetes_tpu.utils import metrics
 from kubernetes_tpu.utils import timeline as _timeline
@@ -33,6 +34,34 @@ from kubernetes_tpu.apiserver.server import (
 )
 
 logger = logging.getLogger(__name__)
+
+
+def _apply_events_py(store: Dict, evs: List[WatchEvent]) -> List:
+    """Pure-Python twin of native ``ingest_apply`` (identical semantics,
+    differentially fuzzed in tests/test_native_ingest.py): apply a frame
+    of events to the informer store and build the handler dispatch list.
+    The (namespace, name) key record is decoded ONCE per event and
+    memoized on ``ev.decoded`` -- sibling informer sets draining the
+    same shared per-kind event log reuse it instead of re-walking
+    ``obj.metadata``."""
+    dispatch = []
+    for ev in evs:
+        obj = ev.object
+        key = ev.decoded
+        if key is None:
+            key = (obj.metadata.namespace, obj.metadata.name)
+            ev.decoded = key
+        if ev.type == ADDED:
+            store[key] = obj
+            dispatch.append((ADDED, None, obj))
+        elif ev.type == MODIFIED:
+            old = store.get(key)
+            store[key] = obj
+            dispatch.append((MODIFIED, old, obj))
+        elif ev.type == DELETED:
+            store.pop(key, None)
+            dispatch.append((DELETED, None, obj))
+    return dispatch
 
 
 class WatchDropped(Exception):
@@ -160,22 +189,16 @@ class Informer:
             self._apply_batch_inner(evs)
 
     def _apply_batch_inner(self, evs: List[WatchEvent]) -> None:
-        dispatch = []
+        fn, expected = _native.ingest_fn("ingest_apply")
         with self._lock:
-            store = self._store
-            for ev in evs:
-                obj = ev.object
-                key = (obj.metadata.namespace, obj.metadata.name)
-                if ev.type == ADDED:
-                    store[key] = obj
-                    dispatch.append((ADDED, None, obj))
-                elif ev.type == MODIFIED:
-                    old = store.get(key)
-                    store[key] = obj
-                    dispatch.append((MODIFIED, old, obj))
-                elif ev.type == DELETED:
-                    store.pop(key, None)
-                    dispatch.append((DELETED, None, obj))
+            if fn is not None:
+                dispatch = fn(self._store, evs)
+            else:
+                if expected:
+                    metrics.ingest_native_fallbacks.inc(
+                        site="informer-apply"
+                    )
+                dispatch = _apply_events_py(self._store, evs)
         self._dispatch(dispatch)
 
     def _dispatch(self, dispatch: List) -> None:

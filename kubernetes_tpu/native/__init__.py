@@ -79,3 +79,41 @@ cow_clone = getattr(hotpath, "cow_clone", None)
 assume_clones = getattr(hotpath, "assume_clones", None)
 bind_assumed_bulk = getattr(hotpath, "bind_assumed_bulk", None)
 commit_gather = getattr(hotpath, "commit_gather", None)
+
+# -- the ingest plane (see _hotpath.c "ingest spine") ---------------------
+#
+# Gated separately from the commit-path loops by KTPU_NATIVE_INGEST
+# (default on): =0 forces the pure-Python twins at every ingest call
+# site, the differential-test and A/B-bench switch. The env var is read
+# PER CALL of ``ingest_fn`` (cheap: once per frame/batch, not per pod)
+# so tests can flip it without re-importing the world.
+
+_INGEST_FNS = {
+    name: getattr(hotpath, name, None)
+    for name in (
+        "ingest_decode", "ingest_apply", "ingest_stamp",
+        "pack_gather", "queue_shape",
+    )
+}
+
+
+def ingest_on() -> bool:
+    """True when the native ingest plane is not disabled by env."""
+    return os.environ.get("KTPU_NATIVE_INGEST", "1") not in ("0", "false")
+
+
+def ingest_native_active() -> bool:
+    """True when ingest calls will actually run the C path (env on AND
+    the extension built) -- the machine-readable bench label."""
+    return ingest_on() and _INGEST_FNS.get("ingest_apply") is not None
+
+
+def ingest_fn(name: str):
+    """(callable_or_None, expected): the native ingest entry point, or
+    None with ``expected`` telling the caller whether running the
+    Python twin counts as a FALLBACK (native wanted but unavailable --
+    the caller books scheduler_ingest_native_fallbacks_total) or as the
+    configured path (KTPU_NATIVE_INGEST=0)."""
+    if not ingest_on():
+        return None, False
+    return _INGEST_FNS.get(name), True

@@ -29,6 +29,7 @@
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <stdint.h>
 
 static int
 match_compiled_impl(PyObject *labels, PyObject *compiled)
@@ -770,6 +771,873 @@ bind_assumed_bulk(PyObject *self, PyObject *args)
     return Py_BuildValue("(NNl)", errors, events, rv);
 }
 
+/* -- ingest spine --------------------------------------------------------
+ *
+ * The host-side control-plane FRONT END (watch frame -> informer store ->
+ * admission memo -> queue entry -> pack row) walked Python objects per
+ * event per informer set and per pod per pack cycle; after the device-
+ * side delta/carry work the solver outran its input (ROADMAP item 5).
+ * These loops move that walking into C, in three layers:
+ *
+ *   ingest_decode / ingest_apply -- watch frames are decoded ONCE per
+ *     apiserver transaction into an immutable (namespace, name) key
+ *     record memoized on the WatchEvent (`decoded` slot); every informer
+ *     cursor (N partitioned stacks share the per-kind event log) applies
+ *     the frame to its store and builds the handler dispatch list in one
+ *     C pass over those shared records.
+ *
+ *   ingest_stamp -- the admission classifier's fast path: a PLAIN pod
+ *     (no volumes, no affinity, no spread, no NUMA annotation, no gang
+ *     label, no host ports, no unresolved priority class) gets its
+ *     entire ingest record built in one C pass: _req_memo, _nzr_memo,
+ *     _hot_memo, the pack-ready _packrow, _band_priority, and the
+ *     SHARED plain Admission record. Non-plain pods are returned by
+ *     index for the full Python classifier.
+ *
+ *   pack_gather -- pack_pod_batch's per-pod-per-cycle spec walk becomes
+ *     a C gather over the _packrow memos into preallocated int32
+ *     buffers, deduping request rows through a caller-owned dict (only
+ *     DISTINCT rows go back to Python for schema encoding).
+ *
+ *   queue_shape -- the bulk apiserver->queue path: one C pass over a
+ *     create burst's pods producing (keys, priorities, nominations) so
+ *     PriorityQueue.add_many builds its heap entries without per-pod
+ *     attribute walks.
+ *
+ * Pure-Python twins with identical semantics live next to each call
+ * site (client/informer.py, scheduler/admission.py,
+ * tensors/node_tensor.py, queue/scheduling_queue.py), selected by
+ * KTPU_NATIVE_INGEST=0; tests/test_native_ingest.py differentially
+ * fuzzes the two.
+ */
+
+static PyObject *str_obj_attr = NULL;      /* "object" */
+static PyObject *str_type_attr = NULL;     /* "type" */
+static PyObject *str_decoded = NULL;
+static PyObject *str_added = NULL;         /* "ADDED" */
+static PyObject *str_deleted = NULL;       /* "DELETED" */
+static PyObject *str_status = NULL;
+static PyObject *str_nominated = NULL;     /* "nominated_node_name" */
+static PyObject *str_priority = NULL;
+static PyObject *str_priority_class = NULL;
+static PyObject *str_annotations = NULL;
+static PyObject *str_labels = NULL;
+static PyObject *str_volumes = NULL;
+static PyObject *str_affinity = NULL;
+static PyObject *str_spread = NULL;        /* "topology_spread_constraints" */
+static PyObject *str_containers = NULL;
+static PyObject *str_init_containers = NULL;
+static PyObject *str_overhead = NULL;
+static PyObject *str_resources = NULL;
+static PyObject *str_requests = NULL;
+static PyObject *str_ports = NULL;
+static PyObject *str_host_port = NULL;
+static PyObject *str_packrow = NULL;       /* "_packrow" */
+static PyObject *str_band_priority = NULL; /* "_band_priority" */
+static PyObject *str_admission = NULL;     /* "_admission" */
+static PyObject *str_req_memo = NULL;
+static PyObject *str_nzr_memo = NULL;
+static PyObject *str_hot_memo = NULL;
+
+/* Decode one WatchEvent into its shared (namespace, name) key record,
+ * memoized on ev.decoded. Returns a NEW reference. */
+static PyObject *
+decode_event_key(PyObject *ev)
+{
+    PyObject *dec = PyObject_GetAttr(ev, str_decoded);
+    if (dec == NULL)
+        return NULL;
+    if (dec != Py_None)
+        return dec;
+    Py_DECREF(dec);
+    PyObject *obj = PyObject_GetAttr(ev, str_obj_attr);
+    if (obj == NULL)
+        return NULL;
+    PyObject *meta = PyObject_GetAttr(obj, str_metadata);
+    Py_DECREF(obj);
+    if (meta == NULL)
+        return NULL;
+    PyObject *ns = PyObject_GetAttr(meta, str_namespace);
+    PyObject *name = PyObject_GetAttr(meta, str_name);
+    Py_DECREF(meta);
+    if (ns == NULL || name == NULL) {
+        Py_XDECREF(ns);
+        Py_XDECREF(name);
+        return NULL;
+    }
+    PyObject *key = PyTuple_Pack(2, ns, name);
+    Py_DECREF(ns);
+    Py_DECREF(name);
+    if (key == NULL)
+        return NULL;
+    if (PyObject_SetAttr(ev, str_decoded, key) < 0) {
+        Py_DECREF(key);
+        return NULL;
+    }
+    return key;
+}
+
+static PyObject *
+ingest_decode(PyObject *self, PyObject *args)
+{
+    /* ingest_decode(events) -> [key]: decode (and memoize) every
+     * event's key record in one pass; later consumers -- including
+     * sibling informer sets draining the same shared log -- read the
+     * memo instead of re-walking obj.metadata. */
+    PyObject *events;
+    if (!PyArg_ParseTuple(args, "O!", &PyList_Type, &events))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(events);
+    PyObject *out = PyList_New(n);
+    if (out == NULL)
+        return NULL;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *key = decode_event_key(PyList_GET_ITEM(events, i));
+        if (key == NULL) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        PyList_SET_ITEM(out, i, key);
+    }
+    return out;
+}
+
+static int
+ev_type_is(PyObject *t, PyObject *interned)
+{
+    /* identity first (the constants flow from one module), value
+     * compare as the fallback; -1 on error */
+    if (t == interned)
+        return 1;
+    return PyObject_RichCompareBool(t, interned, Py_EQ);
+}
+
+static PyObject *
+ingest_apply(PyObject *self, PyObject *args)
+{
+    /* ingest_apply(store, events) -> [(etype, old, new)]
+     *
+     * The informer's per-frame store update + dispatch build in one C
+     * pass (semantics: client/informer.py _apply_batch_py, the
+     * differential twin). Caller holds the informer store lock. Events
+     * with an unknown type are skipped, matching the Python branch
+     * structure. */
+    PyObject *store, *events;
+    if (!PyArg_ParseTuple(args, "O!O!", &PyDict_Type, &store,
+                          &PyList_Type, &events))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(events);
+    PyObject *dispatch = PyList_New(0);
+    if (dispatch == NULL)
+        return NULL;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *ev = PyList_GET_ITEM(events, i);
+        PyObject *key = decode_event_key(ev);
+        if (key == NULL)
+            goto fail;
+        PyObject *obj = PyObject_GetAttr(ev, str_obj_attr);
+        PyObject *t = obj ? PyObject_GetAttr(ev, str_type_attr) : NULL;
+        if (obj == NULL || t == NULL) {
+            Py_XDECREF(obj);
+            Py_XDECREF(t);
+            Py_DECREF(key);
+            goto fail;
+        }
+        PyObject *slot = NULL;
+        int r = ev_type_is(t, str_added);
+        if (r < 0)
+            goto ev_fail;
+        if (r) {
+            if (PyDict_SetItem(store, key, obj) < 0)
+                goto ev_fail;
+            slot = PyTuple_Pack(3, t, Py_None, obj);
+        } else if ((r = ev_type_is(t, str_modified)) != 0) {
+            if (r < 0)
+                goto ev_fail;
+            PyObject *old = PyDict_GetItemWithError(store, key);
+            if (old == NULL && PyErr_Occurred())
+                goto ev_fail;
+            Py_XINCREF(old);
+            if (PyDict_SetItem(store, key, obj) < 0) {
+                Py_XDECREF(old);
+                goto ev_fail;
+            }
+            slot = PyTuple_Pack(3, t, old ? old : Py_None, obj);
+            Py_XDECREF(old);
+        } else if ((r = ev_type_is(t, str_deleted)) != 0) {
+            if (r < 0)
+                goto ev_fail;
+            PyObject *old = PyDict_GetItemWithError(store, key);
+            if (old == NULL && PyErr_Occurred())
+                goto ev_fail;
+            if (old != NULL && PyDict_DelItem(store, key) < 0)
+                goto ev_fail;
+            slot = PyTuple_Pack(3, t, Py_None, obj);
+        } else {
+            /* unknown event type: no dispatch, no store change */
+            Py_DECREF(obj);
+            Py_DECREF(t);
+            Py_DECREF(key);
+            continue;
+        }
+        Py_DECREF(obj);
+        Py_DECREF(t);
+        Py_DECREF(key);
+        if (slot == NULL)
+            goto fail;
+        if (PyList_Append(dispatch, slot) < 0) {
+            Py_DECREF(slot);
+            goto fail;
+        }
+        Py_DECREF(slot);
+        continue;
+    ev_fail:
+        Py_DECREF(obj);
+        Py_DECREF(t);
+        Py_DECREF(key);
+        goto fail;
+    }
+    return dispatch;
+fail:
+    Py_DECREF(dispatch);
+    return NULL;
+}
+
+/* ceil-divide a nonnegative byte count to KiB (tensors _kib_ceil) */
+static long long
+kib_ceil_ll(long long b)
+{
+    return (b + 1023) / 1024;
+}
+
+/* Build the plain pod's ingest record. Returns 1 stamped, 0 not-plain
+ * (caller routes to the Python classifier), -1 error. cfg layout (built
+ * once by scheduler/batch.py):
+ *   (plain_admission, aligned_key, group_label,
+ *    cpu_name, mem_name, eph_name, pods_name,
+ *    default_cpu, default_mem) */
+static int
+stamp_one(PyObject *pod, PyObject **cfg, long long default_cpu,
+          long long default_mem)
+{
+    PyObject *spec = NULL, *meta = NULL, *req = NULL;
+    PyObject *containers = NULL, *inits = NULL, *overhead = NULL;
+    PyObject *prio = NULL;
+    long long nzr_cpu = 0, nzr_mem = 0;
+    int plain = 0;
+
+    spec = PyObject_GetAttr(pod, str_spec);
+    meta = PyObject_GetAttr(pod, str_metadata);
+    if (spec == NULL || meta == NULL)
+        goto error;
+
+    /* -- plainness gate (mirror admission._is_plain_pod) ------------- */
+    {
+        PyObject *ann = PyObject_GetAttr(meta, str_annotations);
+        if (ann == NULL)
+            goto error;
+        if (!PyDict_Check(ann)) {
+            Py_DECREF(ann);
+            goto not_plain;
+        }
+        PyObject *got = PyDict_GetItemWithError(ann, cfg[1]);
+        Py_DECREF(ann);
+        if (got != NULL)
+            goto not_plain;
+        if (PyErr_Occurred())
+            goto error;
+    }
+    {
+        PyObject *labels = PyObject_GetAttr(meta, str_labels);
+        if (labels == NULL)
+            goto error;
+        if (!PyDict_Check(labels)) {
+            Py_DECREF(labels);
+            goto not_plain;
+        }
+        PyObject *got = PyDict_GetItemWithError(labels, cfg[2]);
+        Py_DECREF(labels);
+        if (got != NULL)
+            goto not_plain;
+        if (PyErr_Occurred())
+            goto error;
+    }
+    {
+        PyObject *v = PyObject_GetAttr(spec, str_volumes);
+        if (v == NULL)
+            goto error;
+        int truth = PyObject_IsTrue(v);
+        Py_DECREF(v);
+        if (truth != 0)
+            goto not_plain; /* has volumes, or error (route to Python) */
+        v = PyObject_GetAttr(spec, str_affinity);
+        if (v == NULL)
+            goto error;
+        int none = (v == Py_None);
+        Py_DECREF(v);
+        if (!none)
+            goto not_plain;
+        v = PyObject_GetAttr(spec, str_spread);
+        if (v == NULL)
+            goto error;
+        truth = PyObject_IsTrue(v);
+        Py_DECREF(v);
+        if (truth != 0)
+            goto not_plain;
+    }
+    prio = PyObject_GetAttr(spec, str_priority);
+    if (prio == NULL)
+        goto error;
+    if (!PyLong_Check(prio))
+        goto not_plain;
+    {
+        int prio_true = PyObject_IsTrue(prio);
+        if (prio_true < 0)
+            goto error;
+        if (!prio_true) {
+            /* bare priorityClassName needs the lister resolver */
+            PyObject *pcn = PyObject_GetAttr(spec, str_priority_class);
+            if (pcn == NULL)
+                goto error;
+            int has_pcn = PyObject_IsTrue(pcn);
+            Py_DECREF(pcn);
+            if (has_pcn != 0)
+                goto not_plain;
+        }
+    }
+
+    /* -- request walk (pod_resource_requests + non_zero_requests) ---- */
+    containers = PyObject_GetAttr(spec, str_containers);
+    inits = PyObject_GetAttr(spec, str_init_containers);
+    overhead = PyObject_GetAttr(spec, str_overhead);
+    if (containers == NULL || inits == NULL || overhead == NULL)
+        goto error;
+    if (!PyList_Check(containers) || !PyList_Check(inits) ||
+        !PyDict_Check(overhead))
+        goto not_plain;
+    req = PyDict_New();
+    if (req == NULL)
+        goto error;
+    for (Py_ssize_t c = 0; c < PyList_GET_SIZE(containers); c++) {
+        PyObject *cont = PyList_GET_ITEM(containers, c);
+        PyObject *ports = PyObject_GetAttr(cont, str_ports);
+        if (ports == NULL)
+            goto error;
+        if (!PyList_Check(ports)) {
+            Py_DECREF(ports);
+            goto not_plain;
+        }
+        for (Py_ssize_t p = 0; p < PyList_GET_SIZE(ports); p++) {
+            PyObject *hp =
+                PyObject_GetAttr(PyList_GET_ITEM(ports, p), str_host_port);
+            if (hp == NULL) {
+                Py_DECREF(ports);
+                goto error;
+            }
+            int truth = PyObject_IsTrue(hp);
+            Py_DECREF(hp);
+            if (truth != 0) {
+                Py_DECREF(ports);
+                goto not_plain;
+            }
+        }
+        Py_DECREF(ports);
+        PyObject *res = PyObject_GetAttr(cont, str_resources);
+        PyObject *reqs = res ? PyObject_GetAttr(res, str_requests) : NULL;
+        Py_XDECREF(res);
+        if (reqs == NULL)
+            goto error;
+        if (!PyDict_Check(reqs)) {
+            Py_DECREF(reqs);
+            goto not_plain;
+        }
+        PyObject *rk, *rv;
+        Py_ssize_t rpos = 0;
+        while (PyDict_Next(reqs, &rpos, &rk, &rv)) {
+            if (!PyLong_Check(rv)) {
+                Py_DECREF(reqs);
+                goto not_plain;
+            }
+            PyObject *cur = PyDict_GetItemWithError(req, rk);
+            if (cur == NULL && PyErr_Occurred()) {
+                Py_DECREF(reqs);
+                goto error;
+            }
+            PyObject *sum;
+            if (cur == NULL) {
+                sum = rv;
+                Py_INCREF(sum);
+            } else {
+                sum = PyNumber_Add(cur, rv);
+                if (sum == NULL) {
+                    Py_DECREF(reqs);
+                    goto error;
+                }
+            }
+            int sr = PyDict_SetItem(req, rk, sum);
+            Py_DECREF(sum);
+            if (sr < 0) {
+                Py_DECREF(reqs);
+                goto error;
+            }
+        }
+        /* non-zero defaults (util/non_zero.go semantics) */
+        PyObject *ccpu = PyDict_GetItemWithError(reqs, cfg[3]);
+        if (ccpu == NULL && PyErr_Occurred()) {
+            Py_DECREF(reqs);
+            goto error;
+        }
+        PyObject *cmem = PyDict_GetItemWithError(reqs, cfg[4]);
+        if (cmem == NULL && PyErr_Occurred()) {
+            Py_DECREF(reqs);
+            goto error;
+        }
+        nzr_cpu += (ccpu != NULL && PyObject_IsTrue(ccpu) == 1)
+                       ? PyLong_AsLongLong(ccpu)
+                       : default_cpu;
+        nzr_mem += (cmem != NULL && PyObject_IsTrue(cmem) == 1)
+                       ? PyLong_AsLongLong(cmem)
+                       : default_mem;
+        Py_DECREF(reqs);
+        if (PyErr_Occurred())
+            goto error;
+    }
+    for (Py_ssize_t c = 0; c < PyList_GET_SIZE(inits); c++) {
+        PyObject *cont = PyList_GET_ITEM(inits, c);
+        PyObject *res = PyObject_GetAttr(cont, str_resources);
+        PyObject *reqs = res ? PyObject_GetAttr(res, str_requests) : NULL;
+        Py_XDECREF(res);
+        if (reqs == NULL)
+            goto error;
+        if (!PyDict_Check(reqs)) {
+            Py_DECREF(reqs);
+            goto not_plain;
+        }
+        PyObject *rk, *rv;
+        Py_ssize_t rpos = 0;
+        while (PyDict_Next(reqs, &rpos, &rk, &rv)) {
+            if (!PyLong_Check(rv)) {
+                Py_DECREF(reqs);
+                goto not_plain;
+            }
+            PyObject *cur = PyDict_GetItemWithError(req, rk);
+            if (cur == NULL && PyErr_Occurred()) {
+                Py_DECREF(reqs);
+                goto error;
+            }
+            /* Python twin: `if qty > out.get(name, 0)` -- an absent
+             * name compares against 0 */
+            PyObject *zero = PyLong_FromLong(0);
+            if (zero == NULL) {
+                Py_DECREF(reqs);
+                goto error;
+            }
+            int gt = PyObject_RichCompareBool(rv, cur ? cur : zero, Py_GT);
+            Py_DECREF(zero);
+            if (gt < 0) {
+                Py_DECREF(reqs);
+                goto error;
+            }
+            if (gt && PyDict_SetItem(req, rk, rv) < 0) {
+                Py_DECREF(reqs);
+                goto error;
+            }
+        }
+        Py_DECREF(reqs);
+    }
+    {
+        PyObject *rk, *rv;
+        Py_ssize_t rpos = 0;
+        while (PyDict_Next(overhead, &rpos, &rk, &rv)) {
+            if (!PyLong_Check(rv))
+                goto not_plain;
+            PyObject *cur = PyDict_GetItemWithError(req, rk);
+            if (cur == NULL && PyErr_Occurred())
+                goto error;
+            PyObject *sum;
+            if (cur == NULL) {
+                sum = rv;
+                Py_INCREF(sum);
+            } else {
+                sum = PyNumber_Add(cur, rv);
+                if (sum == NULL)
+                    goto error;
+            }
+            int sr = PyDict_SetItem(req, rk, sum);
+            Py_DECREF(sum);
+            if (sr < 0)
+                goto error;
+        }
+    }
+
+    /* -- build + install the memos ----------------------------------- */
+    {
+        PyObject *zero = PyLong_FromLong(0);
+        PyObject *items = NULL, *scalar = NULL, *hot = NULL, *nzr = NULL;
+        PyObject *packrow = NULL, *key = NULL;
+        PyObject *cpu_q = NULL, *mem_q = NULL, *eph_q = NULL;
+        PyObject *nzr_cpu_obj = NULL, *nzr_mem_obj = NULL, *kib_obj = NULL;
+        PyObject *d = NULL;
+        int ok = 0;
+        if (zero == NULL)
+            goto build_done;
+
+        Py_ssize_t nreq = PyDict_GET_SIZE(req);
+        items = PyTuple_New(nreq);
+        scalar = PyList_New(0);
+        if (items == NULL || scalar == NULL)
+            goto build_done;
+        {
+            PyObject *rk, *rv;
+            Py_ssize_t rpos = 0, j = 0;
+            while (PyDict_Next(req, &rpos, &rk, &rv)) {
+                PyObject *pair = PyTuple_Pack(2, rk, rv);
+                if (pair == NULL)
+                    goto build_done;
+                PyTuple_SET_ITEM(items, j++, pair);
+                int fixed =
+                    PyObject_RichCompareBool(rk, cfg[3], Py_EQ) == 1 ||
+                    PyObject_RichCompareBool(rk, cfg[4], Py_EQ) == 1 ||
+                    PyObject_RichCompareBool(rk, cfg[5], Py_EQ) == 1 ||
+                    PyObject_RichCompareBool(rk, cfg[6], Py_EQ) == 1;
+                if (PyErr_Occurred())
+                    goto build_done;
+                if (!fixed) {
+                    PyObject *spair = PyTuple_Pack(2, rk, rv);
+                    if (spair == NULL)
+                        goto build_done;
+                    int ap = PyList_Append(scalar, spair);
+                    Py_DECREF(spair);
+                    if (ap < 0)
+                        goto build_done;
+                }
+            }
+        }
+        cpu_q = PyDict_GetItemWithError(req, cfg[3]);
+        mem_q = PyDict_GetItemWithError(req, cfg[4]);
+        eph_q = PyDict_GetItemWithError(req, cfg[5]);
+        if (PyErr_Occurred())
+            goto build_done;
+        if (cpu_q == NULL)
+            cpu_q = zero;
+        if (mem_q == NULL)
+            mem_q = zero;
+        if (eph_q == NULL)
+            eph_q = zero;
+        nzr_cpu_obj = PyLong_FromLongLong(nzr_cpu);
+        nzr_mem_obj = PyLong_FromLongLong(nzr_mem);
+        kib_obj = PyLong_FromLongLong(kib_ceil_ll(nzr_mem));
+        if (nzr_cpu_obj == NULL || nzr_mem_obj == NULL || kib_obj == NULL)
+            goto build_done;
+        {
+            PyObject *scalar_t = PyList_AsTuple(scalar);
+            if (scalar_t == NULL)
+                goto build_done;
+            PyObject *empty = PyTuple_New(0);
+            if (empty == NULL) {
+                Py_DECREF(scalar_t);
+                goto build_done;
+            }
+            hot = PyTuple_Pack(8, cpu_q, mem_q, eph_q, scalar_t,
+                               nzr_cpu_obj, nzr_mem_obj, Py_False, empty);
+            Py_DECREF(scalar_t);
+            Py_DECREF(empty);
+        }
+        nzr = PyTuple_Pack(2, nzr_cpu_obj, nzr_mem_obj);
+        if (hot == NULL || nzr == NULL)
+            goto build_done;
+        {
+            PyObject *empty = PyTuple_New(0);
+            if (empty == NULL)
+                goto build_done;
+            key = PyTuple_Pack(2, items, empty);
+            Py_DECREF(empty);
+        }
+        if (key == NULL)
+            goto build_done;
+        packrow = PyTuple_Pack(4, key, nzr_cpu_obj, kib_obj, prio);
+        if (packrow == NULL)
+            goto build_done;
+
+        d = PyObject_GetAttr(pod, str_dict);
+        if (d == NULL || !PyDict_Check(d))
+            goto build_done;
+        if (PyDict_SetItem(d, str_req_memo, req) < 0 ||
+            PyDict_SetItem(d, str_nzr_memo, nzr) < 0 ||
+            PyDict_SetItem(d, str_hot_memo, hot) < 0 ||
+            PyDict_SetItem(d, str_packrow, packrow) < 0 ||
+            PyDict_SetItem(d, str_band_priority, prio) < 0 ||
+            PyDict_SetItem(d, str_admission, cfg[0]) < 0)
+            goto build_done;
+        ok = 1;
+    build_done:
+        Py_XDECREF(zero);
+        Py_XDECREF(items);
+        Py_XDECREF(scalar);
+        Py_XDECREF(hot);
+        Py_XDECREF(nzr);
+        Py_XDECREF(key);
+        Py_XDECREF(packrow);
+        Py_XDECREF(nzr_cpu_obj);
+        Py_XDECREF(nzr_mem_obj);
+        Py_XDECREF(kib_obj);
+        Py_XDECREF(d);
+        if (!ok)
+            goto error;
+    }
+    plain = 1;
+    goto done;
+
+not_plain:
+    /* several gates route a FAILED truth test here ("broken shape: let
+     * the Python classifier own the error") -- the pending exception
+     * must not leak into the caller's success return */
+    PyErr_Clear();
+    plain = 0;
+    goto done;
+error:
+    plain = -1;
+done:
+    Py_XDECREF(spec);
+    Py_XDECREF(meta);
+    Py_XDECREF(req);
+    Py_XDECREF(containers);
+    Py_XDECREF(inits);
+    Py_XDECREF(overhead);
+    Py_XDECREF(prio);
+    return plain;
+}
+
+static PyObject *
+ingest_stamp(PyObject *self, PyObject *args)
+{
+    /* ingest_stamp(pods, cfg) -> [index of non-plain pods]
+     *
+     * One C pass over a watch frame's new pending pods: plain pods get
+     * their full ingest record (memos + shared Admission) stamped here;
+     * the returned indices take the full Python classifier. Semantics:
+     * scheduler/admission.py stamp_plain_pods (the differential
+     * twin). */
+    PyObject *pods, *cfg_t;
+    if (!PyArg_ParseTuple(args, "O!O!", &PyList_Type, &pods,
+                          &PyTuple_Type, &cfg_t))
+        return NULL;
+    if (PyTuple_GET_SIZE(cfg_t) != 9) {
+        PyErr_SetString(PyExc_ValueError, "ingest_stamp cfg must have 9 items");
+        return NULL;
+    }
+    PyObject *cfg[9];
+    for (int i = 0; i < 9; i++)
+        cfg[i] = PyTuple_GET_ITEM(cfg_t, i);
+    long long default_cpu = PyLong_AsLongLong(cfg[7]);
+    long long default_mem = PyLong_AsLongLong(cfg[8]);
+    if (PyErr_Occurred())
+        return NULL;
+    PyObject *rest = PyList_New(0);
+    if (rest == NULL)
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(pods);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        int r = stamp_one(PyList_GET_ITEM(pods, i), cfg, default_cpu,
+                          default_mem);
+        if (r < 0) {
+            /* a broken pod object routes to the Python classifier,
+             * which owns the error handling (classify wraps in
+             * try/except) -- the fast path never half-stamps */
+            PyErr_Clear();
+            r = 0;
+        }
+        if (r == 0) {
+            PyObject *idx = PyLong_FromSsize_t(i);
+            if (idx == NULL) {
+                Py_DECREF(rest);
+                return NULL;
+            }
+            int ap = PyList_Append(rest, idx);
+            Py_DECREF(idx);
+            if (ap < 0) {
+                Py_DECREF(rest);
+                return NULL;
+            }
+        }
+    }
+    return rest;
+}
+
+static PyObject *
+pack_gather(PyObject *self, PyObject *args)
+{
+    /* pack_gather(pods, stamp, row_cache, idx, nzr, prio) -> new_keys
+     *
+     * The pack-ready-row gather: per pod, read the _packrow memo
+     * (calling back into `stamp` for the rare miss), dedup its request
+     * key through `row_cache` (key -> uniq index), and write
+     * idx/nzr/prio straight into the caller's preallocated int32
+     * buffers. Returns the DISTINCT keys first seen this call, in
+     * order -- the only per-row work left in Python is encoding those
+     * few distinct rows against the schema. Twin:
+     * tensors/node_tensor.py _pack_gather_py. */
+    PyObject *pods, *stamp, *row_cache;
+    Py_buffer idx_buf, nzr_buf, prio_buf;
+    if (!PyArg_ParseTuple(args, "O!OO!w*w*w*", &PyList_Type, &pods, &stamp,
+                          &PyDict_Type, &row_cache, &idx_buf, &nzr_buf,
+                          &prio_buf))
+        return NULL;
+    Py_ssize_t b = PyList_GET_SIZE(pods);
+    PyObject *new_keys = NULL;
+    if ((Py_ssize_t)(idx_buf.len) < b * 4 ||
+        (Py_ssize_t)(nzr_buf.len) < b * 8 ||
+        (Py_ssize_t)(prio_buf.len) < b * 4) {
+        PyErr_SetString(PyExc_ValueError, "pack_gather buffers too small");
+        goto out;
+    }
+    new_keys = PyList_New(0);
+    if (new_keys == NULL)
+        goto out;
+    {
+        int32_t *idx32 = (int32_t *)idx_buf.buf;
+        int32_t *nzr32 = (int32_t *)nzr_buf.buf;
+        int32_t *prio32 = (int32_t *)prio_buf.buf;
+        for (Py_ssize_t i = 0; i < b; i++) {
+            PyObject *pod = PyList_GET_ITEM(pods, i);
+            PyObject *d = PyObject_GetAttr(pod, str_dict);
+            if (d == NULL)
+                goto fail;
+            PyObject *memo =
+                PyDict_Check(d) ? PyDict_GetItemWithError(d, str_packrow)
+                                : NULL;
+            Py_XINCREF(memo);
+            Py_DECREF(d);
+            if (memo == NULL) {
+                if (PyErr_Occurred())
+                    goto fail;
+                memo = PyObject_CallFunctionObjArgs(stamp, pod, NULL);
+                if (memo == NULL)
+                    goto fail;
+            }
+            if (!PyTuple_Check(memo) || PyTuple_GET_SIZE(memo) != 4) {
+                Py_DECREF(memo);
+                PyErr_SetString(PyExc_TypeError, "bad _packrow memo");
+                goto fail;
+            }
+            PyObject *key = PyTuple_GET_ITEM(memo, 0);
+            PyObject *u_obj = PyDict_GetItemWithError(row_cache, key);
+            long u;
+            if (u_obj == NULL) {
+                if (PyErr_Occurred()) {
+                    Py_DECREF(memo);
+                    goto fail;
+                }
+                u = (long)PyDict_GET_SIZE(row_cache);
+                PyObject *u_new = PyLong_FromLong(u);
+                if (u_new == NULL ||
+                    PyDict_SetItem(row_cache, key, u_new) < 0 ||
+                    PyList_Append(new_keys, key) < 0) {
+                    Py_XDECREF(u_new);
+                    Py_DECREF(memo);
+                    goto fail;
+                }
+                Py_DECREF(u_new);
+            } else {
+                u = PyLong_AsLong(u_obj);
+                if (u == -1 && PyErr_Occurred()) {
+                    Py_DECREF(memo);
+                    goto fail;
+                }
+            }
+            long long cpu = PyLong_AsLongLong(PyTuple_GET_ITEM(memo, 1));
+            long long mem = PyLong_AsLongLong(PyTuple_GET_ITEM(memo, 2));
+            long long pr = PyLong_AsLongLong(PyTuple_GET_ITEM(memo, 3));
+            Py_DECREF(memo);
+            if (PyErr_Occurred())
+                goto fail;
+            /* the Python twin's numpy int32 assignment raises
+             * OverflowError on out-of-range values -- silent wraparound
+             * here would corrupt the fit/score inputs and diverge the
+             * two paths */
+            if (cpu < INT32_MIN || cpu > INT32_MAX ||
+                mem < INT32_MIN || mem > INT32_MAX ||
+                pr < INT32_MIN || pr > INT32_MAX) {
+                PyErr_SetString(PyExc_OverflowError,
+                                "_packrow value out of int32 range");
+                goto fail;
+            }
+            idx32[i] = (int32_t)u;
+            nzr32[2 * i] = (int32_t)cpu;
+            nzr32[2 * i + 1] = (int32_t)mem;
+            prio32[i] = (int32_t)pr;
+        }
+    }
+    goto out;
+fail:
+    Py_XDECREF(new_keys);
+    new_keys = NULL;
+out:
+    PyBuffer_Release(&idx_buf);
+    PyBuffer_Release(&nzr_buf);
+    PyBuffer_Release(&prio_buf);
+    return new_keys;
+}
+
+static PyObject *
+queue_shape(PyObject *self, PyObject *args)
+{
+    /* queue_shape(pods) -> (keys, prios, noms)
+     *
+     * One C pass shaping a create burst for the bulk activeQ add:
+     * "ns/name" key strings (the heap's key space), spec.priority (the
+     * PrioritySort sort-key component), and status.nominated_node_name
+     * per pod. Twin: queue/scheduling_queue.py _queue_shape_py. */
+    PyObject *pods;
+    if (!PyArg_ParseTuple(args, "O!", &PyList_Type, &pods))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(pods);
+    PyObject *keys = PyList_New(n);
+    PyObject *prios = PyList_New(n);
+    PyObject *noms = PyList_New(n);
+    if (keys == NULL || prios == NULL || noms == NULL)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *pod = PyList_GET_ITEM(pods, i);
+        PyObject *meta = PyObject_GetAttr(pod, str_metadata);
+        if (meta == NULL)
+            goto fail;
+        PyObject *ns = PyObject_GetAttr(meta, str_namespace);
+        PyObject *name = PyObject_GetAttr(meta, str_name);
+        Py_DECREF(meta);
+        if (ns == NULL || name == NULL) {
+            Py_XDECREF(ns);
+            Py_XDECREF(name);
+            goto fail;
+        }
+        PyObject *key = PyUnicode_FromFormat("%U/%U", ns, name);
+        Py_DECREF(ns);
+        Py_DECREF(name);
+        if (key == NULL)
+            goto fail;
+        PyList_SET_ITEM(keys, i, key);
+        PyObject *spec = PyObject_GetAttr(pod, str_spec);
+        PyObject *prio = spec ? PyObject_GetAttr(spec, str_priority) : NULL;
+        Py_XDECREF(spec);
+        if (prio == NULL)
+            goto fail;
+        PyList_SET_ITEM(prios, i, prio);
+        PyObject *status = PyObject_GetAttr(pod, str_status);
+        PyObject *nom =
+            status ? PyObject_GetAttr(status, str_nominated) : NULL;
+        Py_XDECREF(status);
+        if (nom == NULL)
+            goto fail;
+        PyList_SET_ITEM(noms, i, nom);
+    }
+    return Py_BuildValue("(NNN)", keys, prios, noms);
+fail:
+    Py_XDECREF(keys);
+    Py_XDECREF(prios);
+    Py_XDECREF(noms);
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
     {"match_compiled", match_compiled, METH_VARARGS,
      "match_compiled(labels, compiled) -> bool"},
@@ -789,6 +1657,17 @@ static PyMethodDef methods[] = {
     {"bind_assumed_bulk", bind_assumed_bulk, METH_VARARGS,
      "bind_assumed_bulk(store, assumed_list, rv, event_cls) -> "
      "(errors, events, new_rv)"},
+    {"ingest_decode", ingest_decode, METH_VARARGS,
+     "ingest_decode(events) -> [key]: memoize per-event key records"},
+    {"ingest_apply", ingest_apply, METH_VARARGS,
+     "ingest_apply(store, events) -> [(etype, old, new)]"},
+    {"ingest_stamp", ingest_stamp, METH_VARARGS,
+     "ingest_stamp(pods, cfg) -> [non-plain indices]; plain pods get "
+     "their full ingest record stamped in C"},
+    {"pack_gather", pack_gather, METH_VARARGS,
+     "pack_gather(pods, stamp, row_cache, idx, nzr, prio) -> new_keys"},
+    {"queue_shape", queue_shape, METH_VARARGS,
+     "queue_shape(pods) -> (keys, prios, noms)"},
     {NULL, NULL, 0, NULL},
 };
 
@@ -812,10 +1691,51 @@ PyInit__hotpath(void)
     str_sig_memo = PyUnicode_InternFromString("_sig_memo");
     str_modified = PyUnicode_InternFromString("MODIFIED");
     str_pod = PyUnicode_InternFromString("pod");
+    str_obj_attr = PyUnicode_InternFromString("object");
+    str_type_attr = PyUnicode_InternFromString("type");
+    str_decoded = PyUnicode_InternFromString("decoded");
+    str_added = PyUnicode_InternFromString("ADDED");
+    str_deleted = PyUnicode_InternFromString("DELETED");
+    str_status = PyUnicode_InternFromString("status");
+    str_nominated = PyUnicode_InternFromString("nominated_node_name");
+    str_priority = PyUnicode_InternFromString("priority");
+    str_priority_class = PyUnicode_InternFromString("priority_class_name");
+    str_annotations = PyUnicode_InternFromString("annotations");
+    str_labels = PyUnicode_InternFromString("labels");
+    str_volumes = PyUnicode_InternFromString("volumes");
+    str_affinity = PyUnicode_InternFromString("affinity");
+    str_spread =
+        PyUnicode_InternFromString("topology_spread_constraints");
+    str_containers = PyUnicode_InternFromString("containers");
+    str_init_containers = PyUnicode_InternFromString("init_containers");
+    str_overhead = PyUnicode_InternFromString("overhead");
+    str_resources = PyUnicode_InternFromString("resources");
+    str_requests = PyUnicode_InternFromString("requests");
+    str_ports = PyUnicode_InternFromString("ports");
+    str_host_port = PyUnicode_InternFromString("host_port");
+    str_packrow = PyUnicode_InternFromString("_packrow");
+    str_band_priority = PyUnicode_InternFromString("_band_priority");
+    str_admission = PyUnicode_InternFromString("_admission");
+    str_req_memo = PyUnicode_InternFromString("_req_memo");
+    str_nzr_memo = PyUnicode_InternFromString("_nzr_memo");
+    str_hot_memo = PyUnicode_InternFromString("_hot_memo");
     if (str_dict == NULL || str_spec == NULL || str_node_name == NULL ||
         str_metadata == NULL || str_namespace == NULL ||
         str_name == NULL || str_uid == NULL || str_resource_version == NULL ||
-        str_sig_memo == NULL || str_modified == NULL || str_pod == NULL)
+        str_sig_memo == NULL || str_modified == NULL || str_pod == NULL ||
+        str_obj_attr == NULL || str_type_attr == NULL ||
+        str_decoded == NULL || str_added == NULL || str_deleted == NULL ||
+        str_status == NULL || str_nominated == NULL ||
+        str_priority == NULL || str_priority_class == NULL ||
+        str_annotations == NULL || str_labels == NULL ||
+        str_volumes == NULL || str_affinity == NULL || str_spread == NULL ||
+        str_containers == NULL || str_init_containers == NULL ||
+        str_overhead == NULL || str_resources == NULL ||
+        str_requests == NULL || str_ports == NULL ||
+        str_host_port == NULL || str_packrow == NULL ||
+        str_band_priority == NULL || str_admission == NULL ||
+        str_req_memo == NULL || str_nzr_memo == NULL ||
+        str_hot_memo == NULL)
         return NULL;
     return PyModule_Create(&moduledef);
 }

@@ -82,6 +82,48 @@ class Heap:
         )
         self._maybe_compact()
 
+    def add_bulk(
+        self,
+        objs: List[Any],
+        keys: Optional[List[str]] = None,
+        sort_keys: Optional[List[Any]] = None,
+    ) -> None:
+        """Insert many objects under one structural pass -- the bulk
+        apiserver->queue ingest path. Semantics per object are exactly
+        ``add`` (later duplicates tombstone earlier ones), but the heap
+        work batches: when the new items rival the live heap in size, one
+        ``extend`` + C-level ``heapify`` replaces N pushes. ``keys`` /
+        ``sort_keys``, when precomputed by the caller (the native
+        queue_shape pass), skip the per-object key/sort-key calls."""
+        if not objs:
+            return
+        if keys is None:
+            key_f = self._key
+            keys = [key_f(o) for o in objs]
+        if sort_keys is None:
+            sk = self._sort_key
+            sort_keys = [sk(o) for o in objs]
+        entries = self._entries
+        heap = self._heap
+        seq = self._seq
+        new_items = []
+        for obj, key, skey in zip(objs, keys, sort_keys):
+            old = entries.get(key)
+            if old is not None:
+                old[1] = False
+                self._dead += 1
+            entry = [obj, True]
+            entries[key] = entry
+            new_items.append([skey, next(seq), entry])
+        if len(new_items) * 4 >= len(heap):
+            heap.extend(new_items)
+            heapq.heapify(heap)
+        else:
+            push = heapq.heappush
+            for item in new_items:
+                push(heap, item)
+        self._maybe_compact()
+
     def add_if_not_present(self, obj: Any) -> None:
         if self._key(obj) not in self._entries:
             self.add(obj)

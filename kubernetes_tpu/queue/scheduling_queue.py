@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from kubernetes_tpu import native as _native
 from kubernetes_tpu.api.types import Pod
 from kubernetes_tpu.framework.interface import PodInfo
 from kubernetes_tpu.queue import events
@@ -47,6 +48,23 @@ def _is_pod_updated(old: Optional[Pod], new: Pod) -> bool:
 
 def _info_key(pi: PodInfo) -> str:
     return _pod_key(pi.pod)
+
+
+def _queue_shape_py(pods: List[Pod]):
+    """Pure-Python twin of native ``queue_shape`` (identical semantics;
+    tests/test_native_ingest.py fuzzes the two): one pass shaping a
+    create burst for the bulk activeQ add -- heap key strings,
+    spec.priority (the PrioritySort sort-key component), and
+    status.nominated_node_name per pod."""
+    keys = []
+    prios = []
+    noms = []
+    for pod in pods:
+        meta = pod.metadata
+        keys.append(f"{meta.namespace}/{meta.name}")
+        prios.append(pod.spec.priority)
+        noms.append(pod.status.nominated_node_name)
+    return keys, prios, noms
 
 
 def _band_priority(pod: Pod) -> int:
@@ -133,6 +151,18 @@ class PriorityQueue:
         # only mutated before re-adding, so the snapshot stays valid)
         self.active_q = Heap(_info_key, less_func, sort_key=sort_key_func)
         self.pod_backoff_q = Heap(_info_key, sort_key=self._backoff_time)
+        # bulk-add fast path: when the queue-sort key is the stock
+        # PrioritySort tuple ((-priority, timestamp)), add_many can
+        # derive every sort key from the shaped priorities instead of
+        # calling the key func per pod; any custom plugin key keeps the
+        # per-entry call
+        from kubernetes_tpu.plugins.queuesort import PrioritySort
+
+        self._prio_sort_keys = (
+            sort_key_func is not None
+            and getattr(sort_key_func, "__func__", None)
+            is PrioritySort.queue_sort_key
+        )
         self.unschedulable_q: Dict[str, PodInfo] = {}
         self.nominated_pods = _NominatedPodMap()
 
@@ -191,13 +221,50 @@ class PriorityQueue:
 
     def add_many(self, pods: List[Pod]) -> None:
         """Bulk add under one lock hold + one wakeup (a watch frame's
-        worth of new pending pods)."""
+        worth of new pending pods).
+
+        The bulk apiserver->queue ingest path: one native pass
+        (``queue_shape``; Python twin ``_queue_shape_py`` behind
+        KTPU_NATIVE_INGEST=0) shapes the burst into heap keys,
+        priorities, and nominations, and ``Heap.add_bulk`` lands the
+        entries with one C-level heapify instead of per-pod pushes --
+        ``pop_bulk`` then drains exactly what ingest already shaped.
+        Per-pod semantics are ``_add_locked``'s, differentially pinned
+        in tests/test_native_ingest.py."""
         if not pods:
             return
+        pods_l = pods if isinstance(pods, list) else list(pods)
+        fn, expected = _native.ingest_fn("queue_shape")
+        if fn is not None:
+            keys, prios, noms = fn(pods_l)
+        else:
+            if expected:
+                metrics.ingest_native_fallbacks.inc(site="queue-shape")
+            keys, prios, noms = _queue_shape_py(pods_l)
         with self._cond:
             now = self._now()
-            for pod in pods:
-                self._add_locked(pod, now)
+            infos = [PodInfo(pod, now) for pod in pods_l]
+            sort_keys = (
+                [(-p, now) for p in prios]
+                if self._prio_sort_keys
+                else None
+            )
+            self.active_q.add_bulk(infos, keys, sort_keys)
+            usq = self.unschedulable_q
+            if usq:
+                for key in keys:
+                    usq.pop(key, None)
+            bq = self.pod_backoff_q
+            if len(bq):
+                for key in keys:
+                    bq.delete_by_key(key)
+            # nomination re-install only when any pod carries one (or
+            # the map holds entries to clear) -- the burst common case
+            # skips the per-pod map walk entirely
+            nmap = self.nominated_pods
+            if nmap.nominated_pod_to_node or any(noms):
+                for pod in pods_l:
+                    nmap.add(pod, "")
             self._cond.notify()
 
     def delete_many(self, pods: List[Pod]) -> None:

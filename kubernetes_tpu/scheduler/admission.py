@@ -39,11 +39,23 @@ registry) is never trusted.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
-from kubernetes_tpu.api.types import POD_GROUP_LABEL, Pod
-from kubernetes_tpu.cache.node_info import pod_hot_info
+from kubernetes_tpu.api.types import (
+    POD_GROUP_LABEL,
+    Pod,
+    RESOURCE_CPU,
+    RESOURCE_EPHEMERAL_STORAGE,
+    RESOURCE_MEMORY,
+    RESOURCE_PODS,
+)
+from kubernetes_tpu.cache.node_info import (
+    DEFAULT_MEMORY_REQUEST,
+    DEFAULT_MILLI_CPU_REQUEST,
+    pod_hot_info,
+)
 from kubernetes_tpu.plugins.numa import ALIGNED_ANNOTATION
+from kubernetes_tpu.tensors.node_tensor import _kib_ceil, stamp_pack_row
 
 
 def solver_unsupported_reason(pod: Pod) -> str:
@@ -216,4 +228,134 @@ def classify_pod(
             pod.__dict__.pop("_band_priority", None)
 
     pod.__dict__["_admission"] = adm
+    # pack-ready row record (tensors/node_tensor.py): stamped HERE, at
+    # ingest, after the volume classification resolved _volcount_memo --
+    # pack_pod_batch's per-cycle loop is then a pure memo gather
+    stamp_pack_row(pod)
     return adm
+
+
+# -- the plain-pod fast path (native ingest_stamp + this Python twin) -----
+#
+# A burst is overwhelmingly PLAIN pods: no volumes, no affinity, no
+# spread constraints, no NUMA annotation, no gang label, no host ports,
+# and a priority that needs no PriorityClass resolution. For those the
+# whole classification is a constant -- so one SHARED read-only
+# Admission record serves every plain pod, and the per-pod ingest work
+# reduces to building the spec memos (_req_memo/_nzr_memo/_hot_memo/
+# _packrow/_band_priority), which native/_hotpath.c ``ingest_stamp``
+# does in one C pass. ``stamp_plain_pods`` is the differential twin
+# (tests/test_native_ingest.py); non-plain pods are returned by index
+# for the full ``classify_pod``. Only valid with NO extenders (an
+# extender's is_interested must see every pod).
+
+_FIXED_RESOURCE_NAMES = (
+    RESOURCE_CPU, RESOURCE_MEMORY, RESOURCE_EPHEMERAL_STORAGE,
+    RESOURCE_PODS,
+)
+
+
+def plain_admission(token: object) -> Admission:
+    """The shared Admission record every plain pod points at (read-only
+    by contract: ``as_host_only`` copies before mutating)."""
+    adm = Admission()
+    adm.token = token
+    return adm
+
+
+def ingest_stamp_cfg(plain_adm: Admission) -> Tuple:
+    """The constant tuple native ``ingest_stamp`` takes (one build per
+    scheduler): the shared record, the gate keys, the fixed resource
+    names, and the non-zero defaults."""
+    return (
+        plain_adm, ALIGNED_ANNOTATION, POD_GROUP_LABEL,
+        RESOURCE_CPU, RESOURCE_MEMORY, RESOURCE_EPHEMERAL_STORAGE,
+        RESOURCE_PODS, DEFAULT_MILLI_CPU_REQUEST, DEFAULT_MEMORY_REQUEST,
+    )
+
+
+def _is_plain_pod(pod: Pod) -> bool:
+    meta = pod.metadata
+    spec = pod.spec
+    if not isinstance(meta.annotations, dict) or (
+        ALIGNED_ANNOTATION in meta.annotations
+    ):
+        return False
+    if not isinstance(meta.labels, dict) or POD_GROUP_LABEL in meta.labels:
+        return False
+    if spec.volumes or spec.affinity is not None:
+        return False
+    if spec.topology_spread_constraints:
+        return False
+    if not isinstance(spec.priority, int):
+        return False
+    if not spec.priority and spec.priority_class_name:
+        return False  # bare priorityClassName needs the lister resolver
+    for c in spec.containers:
+        for p in c.ports:
+            if p.host_port:
+                return False
+    return True
+
+
+def _stamp_plain(pod: Pod, plain_adm: Admission) -> None:
+    """Build the plain pod's full ingest record (semantics mirrored
+    bit-for-bit by native ``ingest_stamp``)."""
+    spec = pod.spec
+    req: dict = {}
+    nzr_cpu = 0
+    nzr_mem = 0
+    for c in spec.containers:
+        requests = c.resources.requests
+        for name, qty in requests.items():
+            if not isinstance(qty, int):
+                raise TypeError("non-int resource quantity")
+            req[name] = req.get(name, 0) + qty
+        ccpu = requests.get(RESOURCE_CPU, 0)
+        cmem = requests.get(RESOURCE_MEMORY, 0)
+        nzr_cpu += ccpu if ccpu else DEFAULT_MILLI_CPU_REQUEST
+        nzr_mem += cmem if cmem else DEFAULT_MEMORY_REQUEST
+    for c in spec.init_containers:
+        for name, qty in c.resources.requests.items():
+            if not isinstance(qty, int):
+                raise TypeError("non-int resource quantity")
+            if qty > req.get(name, 0):
+                req[name] = qty
+    for name, qty in spec.overhead.items():
+        if not isinstance(qty, int):
+            raise TypeError("non-int resource quantity")
+        req[name] = req.get(name, 0) + qty
+    scalar = tuple(
+        (k, v) for k, v in req.items() if k not in _FIXED_RESOURCE_NAMES
+    )
+    d = pod.__dict__
+    d["_req_memo"] = req
+    d["_nzr_memo"] = (nzr_cpu, nzr_mem)
+    d["_hot_memo"] = (
+        req.get(RESOURCE_CPU, 0), req.get(RESOURCE_MEMORY, 0),
+        req.get(RESOURCE_EPHEMERAL_STORAGE, 0), scalar,
+        nzr_cpu, nzr_mem, False, (),
+    )
+    d["_packrow"] = (
+        (tuple(req.items()), ()), nzr_cpu, _kib_ceil(nzr_mem),
+        spec.priority,
+    )
+    d["_band_priority"] = spec.priority
+    d["_admission"] = plain_adm
+
+
+def stamp_plain_pods(pods: List[Pod], plain_adm: Admission) -> List[int]:
+    """Python twin of native ``ingest_stamp``: stamp every plain pod's
+    ingest record, return the indices of pods that need the full
+    classifier (non-plain shapes, or anything that errored -- the fast
+    path never half-stamps)."""
+    rest: List[int] = []
+    for i, pod in enumerate(pods):
+        try:
+            if not _is_plain_pod(pod):
+                rest.append(i)
+                continue
+            _stamp_plain(pod, plain_adm)
+        except Exception:  # noqa: BLE001 - route to the full classifier
+            rest.append(i)
+    return rest

@@ -433,6 +433,11 @@ class BatchScheduler(Scheduler):
         self.admissions_classified = 0
         self.reclassifications = 0
         self.volume_reject_retries = 0  # device NO_NODE -> host re-checks
+        # the plain-pod fast path (native ingest_stamp / its twin): ONE
+        # shared read-only Admission record serves every plain pod, and
+        # the native cfg tuple is built once per scheduler
+        self._plain_adm: Optional[Admission] = None
+        self._ingest_cfg: Optional[tuple] = None
         # per-stage wall-clock accumulators, ALWAYS on (bench.py emits
         # profile_stage_seconds every round; only the per-pod classify
         # timer stays behind profile_stages). Per-THREAD dicts merged at
@@ -821,6 +826,64 @@ class BatchScheduler(Scheduler):
                 if pc is not None:
                     return int(pc.value)
         return pod.spec.priority
+
+    def _plain_admission_record(self) -> Admission:
+        adm = self._plain_adm
+        if adm is None:
+            from kubernetes_tpu.scheduler.admission import plain_admission
+
+            adm = plain_admission(self._admission_token)
+            self._plain_adm = adm
+        return adm
+
+    def classify_pods_bulk(self, pods: List[Pod]) -> None:
+        """One ingest pass over a watch frame's new pending pods (the
+        event handlers' bulk classify): plain pods get their WHOLE
+        ingest record -- spec memos, pack-ready row, band priority, and
+        the shared Admission -- stamped in one native C pass
+        (ingest_stamp; Python twin scheduler/admission.stamp_plain_pods
+        behind KTPU_NATIVE_INGEST=0), and only the non-plain remainder
+        runs the full per-pod classifier. With extenders configured the
+        fast path is off: is_interested must see every pod."""
+        if not pods:
+            return
+        rest_targets: List[Pod] = pods
+        if not self.algorithm.extenders:
+            from kubernetes_tpu import native as _native
+            from kubernetes_tpu.scheduler.admission import (
+                ingest_stamp_cfg,
+                stamp_plain_pods,
+            )
+
+            plain = self._plain_admission_record()
+            fn, expected = _native.ingest_fn("ingest_stamp")
+            rest = None
+            if fn is not None:
+                cfg = self._ingest_cfg
+                if cfg is None:
+                    cfg = ingest_stamp_cfg(plain)
+                    self._ingest_cfg = cfg
+                try:
+                    rest = fn(pods, cfg)
+                except Exception:
+                    # a fast-path failure must NEVER cost the frame its
+                    # enqueue (the caller adds to the queue right after
+                    # this): count it and run the twin
+                    logger.exception("native ingest_stamp failed")
+                    metrics.ingest_native_fallbacks.inc(
+                        site="classify-stamp"
+                    )
+            elif expected:
+                metrics.ingest_native_fallbacks.inc(site="classify-stamp")
+            if rest is None:
+                rest = stamp_plain_pods(pods, plain)
+            self.admissions_classified += len(pods) - len(rest)
+            rest_targets = [pods[i] for i in rest]
+        for pod in rest_targets:
+            try:
+                self.classify_pod(pod)
+            except Exception:
+                logger.exception("classifying pod %s", pod.key())
 
     def attach_volume_counts(self, pod: Pod) -> None:
         """Resolve + memoize a BOUND pod's attachable-volume counts

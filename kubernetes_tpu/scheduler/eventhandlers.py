@@ -61,6 +61,7 @@ def add_all_event_handlers(
     # cache accounts them, and storage-object events bump the
     # volume-topology generation that invalidates cached classifications
     classify = getattr(sched, "classify_pod", None)
+    classify_bulk = getattr(sched, "classify_pods_bulk", None)
     attach_counts = getattr(sched, "attach_volume_counts", None)
     bump_volume_gen = getattr(sched, "bump_volume_topology_gen", None)
 
@@ -321,7 +322,11 @@ def add_all_event_handlers(
                 update_pod_in_cache(*payload)
         for kind, payload in queue_runs:
             if kind == "adds":
-                if classify is not None:
+                # one ingest pass: plain pods stamp their full record in
+                # C (native ingest_stamp), the rest classify per pod
+                if classify_bulk is not None:
+                    classify_bulk(payload)
+                elif classify is not None:
                     for pod in payload:
                         _classify_safe(pod)
                 sched.queue.add_many(payload)

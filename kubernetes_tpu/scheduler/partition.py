@@ -62,7 +62,7 @@ import logging
 import threading
 import time
 import zlib
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 from kubernetes_tpu.api.types import (
     LABEL_ZONE_KEYS,
@@ -85,6 +85,12 @@ SPILL_TARGET_ANNOTATION = "scheduler.tpu/partition"
 #: (normal unschedulable backoff takes over) once every partition has
 #: had a look
 SPILL_COUNT_ANNOTATION = "scheduler.tpu/spill-count"
+#: comma-joined partition ids this pod has already FAILED in. The
+#: feasibility hint makes spill hops non-ring-ordered, so the
+#: every-partition-gets-a-look guarantee can no longer ride the hop
+#: count alone: candidates are picked unvisited-first against this set
+#: (ring revisits only as the last resort within the hop budget)
+SPILL_VISITED_ANNOTATION = "scheduler.tpu/spill-visited"
 
 
 def partition_of_name(name: str, num_partitions: int) -> int:
@@ -244,6 +250,12 @@ class PartitionCoordinator:
         self.adoptions_requeued = 0
         self.adoptions_bound = 0
         self.releases = 0
+        #: spill feasibility hints that stamped the owner directly
+        self.spill_hint_hits = 0
+        # per-signature owner-hint cache (see _spill_owner_hint),
+        # invalidated when the Node list's resourceVersion moves
+        self._spill_hint_cache: Dict[Tuple, Optional[int]] = {}
+        self._spill_hint_rv = -1
 
     # -- partition arithmetic ------------------------------------------------
 
@@ -469,6 +481,62 @@ class PartitionCoordinator:
 
     # -- spill ---------------------------------------------------------------
 
+    def _spill_owner_hint(self, pod: Pod) -> Optional[int]:
+        """Feasibility hint (ROADMAP item-5 residual): which partition
+        OWNS the pod's selector-matching nodes. A nodeSelector/nodeName
+        pod that NO_NODEs here almost always failed on feasibility, not
+        capacity -- ring-ordered spill then walks it through every
+        partition until it happens to land on the owner. This matches
+        the pod's cached constraint signature (the static-mask-row key,
+        ops/host_masks._constraint_signature -- same dedup the mask rows
+        use) against the full Node kind and returns the partition owning
+        the most matching nodes, so the spill stamps the owner directly:
+        one hop max. Pods with no selector/nodeName get no hint (any
+        partition is as good as the next -- ring order stands). The
+        per-signature answer is cached until the Node list's
+        resourceVersion moves."""
+        sel = pod.spec.node_selector
+        pinned = pod.spec.node_name
+        if not sel and not pinned:
+            return None
+        from kubernetes_tpu.ops.host_masks import _constraint_signature
+
+        sig = _constraint_signature(pod)
+        server = self.client.server
+        try:
+            # invalidate on NODE-kind mutations only: the kind's event
+            # log ordinal (base + length) is a monotone count of node
+            # adds/updates/deletes, unlike the global resourceVersion,
+            # which every pod bind bumps (a cache keyed on that would
+            # clear on essentially every call under load)
+            node_gen = server._history_base.get(
+                "Node", 0
+            ) + len(server._history.get("Node", ()))
+        except Exception:  # noqa: BLE001 - foreign server shape
+            node_gen = -1
+        cache = self._spill_hint_cache
+        if node_gen < 0 or node_gen != self._spill_hint_rv:
+            cache.clear()
+            self._spill_hint_rv = node_gen
+        elif sig in cache:
+            return cache[sig]
+        try:
+            nodes, _rv = server.list("Node")
+        except Exception:  # noqa: BLE001 - hint only: ring order stands
+            return None
+        counts: Dict[int, int] = {}
+        for node in nodes:
+            if pinned and node.metadata.name != pinned:
+                continue
+            labels = node.metadata.labels
+            if sel and any(labels.get(k) != v for k, v in sel.items()):
+                continue
+            k = self.note_node(node)
+            counts[k] = counts.get(k, 0) + 1
+        hint = max(counts, key=counts.get) if counts else None
+        cache[sig] = hint
+        return hint
+
     def try_spill(self, pod: Pod) -> bool:
         """Re-stamp an unplaceable pod to the next partition not held by
         this stack and forward it through the apiserver. Returns True
@@ -486,14 +554,44 @@ class PartitionCoordinator:
         if count >= P - 1:
             return False  # every partition has had a look
         cur = self.pod_partition(pod)
+        visited = {cur}
+        for tok in ann.get(SPILL_VISITED_ANNOTATION, "").split(","):
+            try:
+                visited.add(int(tok))
+            except ValueError:
+                pass
         target = None
-        for step in range(1, P):
-            k = (cur + step) % P
-            if k not in self.held:
-                target = k
-                break
+        # feasibility hint first: stamp the partition that owns the
+        # pod's selector-matching nodes directly (one hop max) instead
+        # of walking the ring until the owner happens to come up
+        hint = self._spill_owner_hint(pod)
+        if (
+            hint is not None and hint != cur
+            and hint not in self.held and hint not in visited
+        ):
+            target = hint
+            self.spill_hint_hits += 1
+        if target is None:
+            # UNVISITED-first: a hint hop desynchronizes the ring, so
+            # the walk must not burn the hop budget revisiting
+            # partitions that already failed while a fresh one remains
+            for step in range(1, P):
+                k = (cur + step) % P
+                if k not in self.held and k not in visited:
+                    target = k
+                    break
+        if target is None:
+            # every unvisited partition is held HERE (this stack just
+            # NO_NODEd the pod against its whole slice): fall back to
+            # the classic ring revisit within the remaining hop budget
+            for step in range(1, P):
+                k = (cur + step) % P
+                if k not in self.held:
+                    target = k
+                    break
         if target is None:
             return False  # we hold everything: nowhere to forward
+        visited.add(target)
 
         class _AlreadyBound(Exception):
             pass
@@ -508,6 +606,9 @@ class PartitionCoordinator:
                 **obj.metadata.annotations,
                 SPILL_TARGET_ANNOTATION: str(target),
                 SPILL_COUNT_ANNOTATION: str(count + 1),
+                SPILL_VISITED_ANNOTATION: ",".join(
+                    str(k) for k in sorted(visited)
+                ),
             }
 
         try:

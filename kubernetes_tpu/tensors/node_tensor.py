@@ -48,7 +48,9 @@ from kubernetes_tpu.cache.node_info import (
     pod_hot_info,
 )
 from kubernetes_tpu.cache.snapshot import Snapshot
+from kubernetes_tpu import native as _native
 from kubernetes_tpu.tensors.encoding import TopologyEncoder
+from kubernetes_tpu.utils import metrics as _metrics
 
 NODE_BUCKET = 128  # row padding granularity (TPU lane width)
 
@@ -688,6 +690,57 @@ class PodBatch:
         return len(self.pods)
 
 
+def stamp_pack_row(pod: Pod) -> Tuple:
+    """Build (and memoize as ``pod._packrow``) the pod's pack-ready row
+    record: ``((request_items, vol_counts), nzr_cpu, nzr_mem_kib,
+    priority)``. Stamped at informer ingest by the admission classifier
+    (scheduler/admission.py -- natively for plain pods via
+    ``ingest_stamp``), invalidated by the same paths that strip the
+    other spec memos (apiserver ``_ALL_MEMOS``), so ``pack_pod_batch``
+    and ``pack_preemption_state`` gather memoized rows instead of
+    re-walking specs per pod per cycle. Also primes ``pod_hot_info`` so
+    the commit path's clones carry the accounting memo -- ``_packrow``
+    present implies ``_hot_memo`` present."""
+    req = pod_resource_requests(pod)
+    pod_hot_info(pod)
+    # resolved attachable-volume counts (admission classifier memo):
+    # they ride the request row as volume columns so the fit scan
+    # enforces per-node attach limits
+    vc = tuple(pod.__dict__.get("_volcount_memo") or ())
+    cpu, mem = non_zero_requests(pod)
+    memo = (
+        (tuple(req.items()), vc), cpu, _kib_ceil(mem), pod.spec.priority,
+    )
+    pod.__dict__["_packrow"] = memo
+    return memo
+
+
+def _pack_gather_py(
+    pods: List[Pod], stamp, row_cache: Dict, idx, nzr, prio,
+) -> List[Tuple]:
+    """Pure-Python twin of native ``pack_gather`` (identical semantics;
+    tests/test_native_ingest.py fuzzes the two): gather each pod's
+    ``_packrow`` memo (stamping on miss) into the preallocated int32
+    buffers, dedup request keys through ``row_cache``, return the
+    distinct keys first seen this call in order."""
+    new_keys: List[Tuple] = []
+    for i, pod in enumerate(pods):
+        memo = pod.__dict__.get("_packrow")
+        if memo is None:
+            memo = stamp(pod)
+        key = memo[0]
+        u = row_cache.get(key)
+        if u is None:
+            u = len(row_cache)
+            row_cache[key] = u
+            new_keys.append(key)
+        idx[i] = u
+        nzr[i, 0] = memo[1]
+        nzr[i, 1] = memo[2]
+        prio[i] = memo[3]
+    return new_keys
+
+
 def pack_pod_batch(
     pods: List[Pod],
     dims: ResourceDims,
@@ -697,79 +750,81 @@ def pack_pod_batch(
     comparator (queuesort/priority_sort.go: priority desc, then enqueue
     time) so batched greedy assignment replays the sequential order.
 
+    The per-pod spec walk lives at INGEST now (``stamp_pack_row``, run
+    by the admission classifier when the pod enters the queue): the
+    per-cycle work here is one gather over the ``_packrow`` memos into
+    preallocated ``[B]``/``[B, 2]`` buffers -- a single C pass when the
+    native ingest plane is available -- plus one schema encode per
+    DISTINCT request row (a burst is overwhelmingly homogeneous).
+
     The schema is frozen here (``grow=False``): a pod requesting a scalar
     resource no node advertises is flagged ``unsatisfiable`` instead of
     growing the dim set mid-batch (which would shape-mismatch the
     already-packed node tensor)."""
     b = len(pods)
-    # Content-deduplicated encode: a burst is overwhelmingly homogeneous
-    # (a deployment scale-up packs thousands of identical specs), so
-    # encode each DISTINCT request map once and gather rows vectorized --
-    # the per-pod np.zeros + column-write loop was ~60% of pack time.
+    if b == 0:  # empty batch: preserve the [0, R] contract
+        return PodBatch(
+            pods=[],
+            requests=np.zeros((0, dims.num_dims), dtype=np.int32),
+            non_zero_requests=np.zeros((0, 2), dtype=np.int32),
+            priorities=np.zeros(0, dtype=np.int32),
+            order=np.arange(0, dtype=np.int32),
+            unsatisfiable=np.zeros(0, dtype=bool),
+        )
     row_cache: Dict[Tuple, int] = {}
-    uniq_rows: List[np.ndarray] = []
-    uniq_unknown: List[bool] = []
     idx = np.empty(b, dtype=np.int32)
     nzr = np.empty((b, 2), dtype=np.int32)
-    prio_list = [0] * b
-    for i, pod in enumerate(pods):
-        req = pod_resource_requests(pod)
-        # prime the accounting memo on the ORIGINAL pod here: the commit
-        # path's assume/bind clones copy __dict__, so the memo rides into
-        # every clone and NodeInfo.add_pod never re-derives it
-        pod_hot_info(pod)
-        # resolved attachable-volume counts (admission classifier memo,
-        # scheduler/admission.py): they ride the request row as volume
-        # columns so the fit scan enforces per-node attach limits
-        vc = pod.__dict__.get("_volcount_memo") or ()
-        key = (tuple(req.items()), vc)
-        u = row_cache.get(key)
-        if u is None:
-            row, unknown = dims.encode_requests(req, grow=False)
-            row[PODS] = 1
-            for name, qty in vc:
-                col = dims.existing_column(name)
-                if col is not None:
-                    # unregistered names (a nominee classified by an
-                    # older scheduler instance) are skipped: the overlay
-                    # under-reserves rather than shape-mismatching
-                    row[col] += qty
-            u = len(uniq_rows)
-            uniq_rows.append(row)
-            uniq_unknown.append(unknown)
-            row_cache[key] = u
-        idx[i] = u
-        cpu, mem = non_zero_requests(pod)
-        nzr[i, 0] = cpu
-        nzr[i, 1] = _kib_ceil(mem)
-        prio_list[i] = pod.spec.priority
-    if uniq_rows:
-        requests = np.stack(uniq_rows)[idx]
-        unsatisfiable = np.asarray(uniq_unknown, dtype=bool)[idx]
-    else:  # empty batch: preserve the [0, R] contract
-        requests = np.zeros((0, dims.num_dims), dtype=np.int32)
-        unsatisfiable = np.zeros(0, dtype=bool)
-    priorities = np.asarray(prio_list, dtype=np.int32)
-    ts = timestamps or [pod.metadata.creation_timestamp for pod in pods]
+    prio = np.empty(b, dtype=np.int32)
+    pods_l = pods if isinstance(pods, list) else list(pods)
+    gather, expected = _native.ingest_fn("pack_gather")
+    if gather is not None:
+        new_keys = gather(pods_l, stamp_pack_row, row_cache, idx, nzr, prio)
+    else:
+        if expected:
+            _metrics.ingest_native_fallbacks.inc(site="pack-gather")
+        new_keys = _pack_gather_py(
+            pods_l, stamp_pack_row, row_cache, idx, nzr, prio
+        )
+    # encode each DISTINCT request row once and gather vectorized
+    uniq_rows: List[np.ndarray] = []
+    uniq_unknown: List[bool] = []
+    for req_items, vc in new_keys:
+        row, unknown = dims.encode_requests(dict(req_items), grow=False)
+        row[PODS] = 1
+        for name, qty in vc:
+            col = dims.existing_column(name)
+            if col is not None:
+                # unregistered names (a nominee classified by an older
+                # scheduler instance) are skipped: the overlay
+                # under-reserves rather than shape-mismatching
+                row[col] += qty
+        uniq_rows.append(row)
+        uniq_unknown.append(unknown)
+    requests = np.stack(uniq_rows)[idx]
+    unsatisfiable = np.asarray(uniq_unknown, dtype=bool)[idx]
+    ts = timestamps or [pod.metadata.creation_timestamp for pod in pods_l]
     # pop_batch already drains the activeQ in comparator order (priority
     # desc, enqueue time asc) -- detect the sorted common case and skip
-    # the Python sort
-    if all(
-        prio_list[i] > prio_list[i + 1]
-        or (prio_list[i] == prio_list[i + 1] and ts[i] <= ts[i + 1])
-        for i in range(b - 1)
+    # the Python sort (vectorized: the old per-pod generator was O(B)
+    # interpreter work per pack)
+    ts_arr = np.asarray(ts, dtype=np.float64)
+    if b <= 1 or bool(
+        np.all(
+            (prio[:-1] > prio[1:])
+            | ((prio[:-1] == prio[1:]) & (ts_arr[:-1] <= ts_arr[1:]))
+        )
     ):
         order = np.arange(b, dtype=np.int32)
     else:
         order = np.array(
-            sorted(range(b), key=lambda i: (-prio_list[i], ts[i])),
+            sorted(range(b), key=lambda i: (-int(prio[i]), ts[i])),
             dtype=np.int32,
         )
     return PodBatch(
-        pods=list(pods),
+        pods=list(pods_l),
         requests=requests,
         non_zero_requests=nzr,
-        priorities=priorities,
+        priorities=prio,
         order=order,
         unsatisfiable=unsatisfiable,
     )

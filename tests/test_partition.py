@@ -345,6 +345,121 @@ class TestSpill:
         c2.held = {0: 1, 1: 1}
         assert not c2.try_spill(pod)
 
+    def test_selector_spill_goes_straight_to_owner(self):
+        """ROADMAP item-5 residual: a nodeSelector pod that NO_NODEs on
+        its home stack spills DIRECTLY to the partition owning its
+        selector-matching nodes (one hop), not to the ring successor."""
+        server = APIServer()
+        client = Client(server)
+        pod = (
+            make_pod("sel-pod").container(cpu="100m", memory="128Mi")
+            .node_selector(disktype="ssd").obj()
+        )
+        client.create_pod(pod)
+        sched = _FakeSched()
+        c = PartitionCoordinator(
+            client, sched, _config(num_partitions=3), "s1"
+        )
+        home = c.pod_partition(pod)
+        c.held = {home: 1}
+        # put every selector-matching node in the partition the ring
+        # would visit LAST, and plain nodes everywhere else
+        owner = (home + 2) % 3
+        matched = plain = 0
+        i = 0
+        while matched < 4 or plain < 6:
+            name = f"sel-node-{i}"
+            i += 1
+            k = partition_of_name(name, 3)
+            if k == owner and matched < 4:
+                client.create_node(
+                    make_node(name).label("disktype", "ssd")
+                    .capacity(cpu="4", memory="8Gi").obj()
+                )
+                matched += 1
+            elif k != owner and plain < 6:
+                client.create_node(
+                    make_node(name).capacity(cpu="4", memory="8Gi").obj()
+                )
+                plain += 1
+        assert c.try_spill(pod)
+        live = client.get_pod("default", pod.metadata.name)
+        target = int(live.metadata.annotations[SPILL_TARGET_ANNOTATION])
+        assert target == owner, (
+            f"spill went to {target}, owner is {owner} "
+            f"(ring successor would be {(home + 1) % 3})"
+        )
+        assert c.spill_hint_hits == 1
+        # a plain pod (no selector) keeps ring order
+        pod2 = make_pod("plain-pod").container(cpu="100m").obj()
+        client.create_pod(pod2)
+        home2 = c.pod_partition(pod2)
+        assert c.try_spill(pod2)
+        live2 = client.get_pod("default", "plain-pod")
+        t2 = int(live2.metadata.annotations[SPILL_TARGET_ANNOTATION])
+        ring = next(
+            k for s in range(1, 3)
+            for k in [(home2 + s) % 3] if k not in c.held
+        )
+        assert t2 == ring
+        assert c.spill_hint_hits == 1
+
+    def test_hint_hop_still_gives_every_partition_a_look(self):
+        """A hint hop desynchronizes the ring walk; the visited-set
+        annotation must keep the guarantee: after the hint owner also
+        NO_NODEs, the NEXT spill offers the remaining partition (not a
+        re-visit of home that exhausts the hop budget)."""
+        from kubernetes_tpu.scheduler.partition import (
+            SPILL_VISITED_ANNOTATION,
+        )
+
+        server = APIServer()
+        client = Client(server)
+        pod = (
+            make_pod("cov-pod").container(cpu="100m", memory="128Mi")
+            .node_selector(disktype="ssd").obj()
+        )
+        client.create_pod(pod)
+        c = PartitionCoordinator(
+            client, _FakeSched(), _config(num_partitions=3), "s1"
+        )
+        home = c.pod_partition(pod)
+        hint_owner = (home + 2) % 3  # ring would visit it LAST
+        third = (home + 1) % 3
+        c.held = {home: 1}
+        i = 0
+        made = 0
+        while made < 3:
+            name = f"cov-node-{i}"
+            i += 1
+            if partition_of_name(name, 3) == hint_owner:
+                client.create_node(
+                    make_node(name).label("disktype", "ssd")
+                    .capacity(cpu="4", memory="8Gi").obj()
+                )
+                made += 1
+        assert c.try_spill(pod)
+        live = client.get_pod("default", "cov-pod")
+        assert int(
+            live.metadata.annotations[SPILL_TARGET_ANNOTATION]
+        ) == hint_owner
+        # the hint owner's stack fails it too: the remaining partition
+        # must be offered, not the already-tried home
+        c2 = PartitionCoordinator(
+            client, _FakeSched(), _config(num_partitions=3), "s2"
+        )
+        c2.held = {hint_owner: 1}
+        assert c2.try_spill(live)
+        live = client.get_pod("default", "cov-pod")
+        assert int(
+            live.metadata.annotations[SPILL_TARGET_ANNOTATION]
+        ) == third
+        visited = {
+            int(k) for k in
+            live.metadata.annotations[SPILL_VISITED_ANNOTATION].split(",")
+        }
+        assert visited == {home, hint_owner, third}
+
     def test_spill_aborts_on_already_bound(self):
         from kubernetes_tpu.api.types import Binding
 

@@ -79,9 +79,64 @@ def check_tree(
     return failures
 
 
+def probe_native_extension(base_dir: str | None = None) -> List[Tuple[str, str]]:
+    """Tier-0 probe for the native ingest/commit extension
+    (kubernetes_tpu/native): importing the package must either yield a
+    WORKING extension (compile-on-import succeeded) or degrade to the
+    pure-Python twins CLEANLY -- ``hotpath is None``, every exported
+    fast-path symbol None, ``ingest_native_active()`` False, so the
+    fallback metric (scheduler_ingest_native_fallbacks_total) can count
+    what ran. A crash on import (or a half-exported module) is the
+    failure mode this gate exists to catch: it would take the whole
+    control plane down with it instead of degrading.
+
+    Returns [(what, error)] like ``check_tree`` -- empty means either
+    outcome is healthy."""
+    if base_dir:
+        sys.path.insert(0, base_dir)
+    failures: List[Tuple[str, str]] = []
+    try:
+        from kubernetes_tpu import native
+    except Exception as e:  # noqa: BLE001 - the forbidden outcome
+        return [("kubernetes_tpu.native", f"import crashed: {e}")]
+    exported = (
+        "cow_clone", "assume_clones", "bind_assumed_bulk", "commit_gather",
+    )
+    if native.hotpath is None:
+        # clean-fallback leg: every fast-path symbol must be None and
+        # the ingest plane must report itself inactive
+        for name in exported:
+            if getattr(native, name, None) is not None:
+                failures.append((
+                    f"kubernetes_tpu.native.{name}",
+                    "non-None fast-path symbol after a failed build",
+                ))
+        if native.ingest_native_active():
+            failures.append((
+                "kubernetes_tpu.native.ingest_native_active",
+                "reports active with no extension built",
+            ))
+    else:
+        # built leg: the ingest spine must be fully exported (a stale
+        # .so missing entry points would half-run the plane)
+        for name in exported + (
+            "ingest_decode", "ingest_apply", "ingest_stamp",
+            "pack_gather", "queue_shape",
+        ):
+            if getattr(native.hotpath, name, None) is None:
+                failures.append((
+                    f"kubernetes_tpu.native._hotpath.{name}",
+                    "missing from the built extension (stale .so?)",
+                ))
+    return failures
+
+
 def main(argv: List[str]) -> int:
     roots = argv or list(DEFAULT_ROOTS)
     failures = check_tree(roots)
+    failures += probe_native_extension(
+        base_dir=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
     if failures:
         for path, err in failures:
             print(f"SYNTAX ERROR: {path}: {err}", file=sys.stderr)
