@@ -44,6 +44,18 @@ only counts if every rung below it also passed -- a config that blows
 the budget at low rate doesn't get credit for a lucky high-rate pass.
 Open-loop env knobs: OPEN_LOOP_RATES, OPEN_LOOP_STEP_S; BENCH_NODES
 defaults to 2000 in this mode.
+
+Observability (ISSUE 13): ``--trace out.json`` arms the flight
+recorder's Chrome-trace buffer for the measured window and writes a
+Perfetto-loadable timeline (host stage spans per thread, device solve
+spans, ArrivalEngine backpressure stalls, autobatch decisions as
+instant events) -- load it at ui.perfetto.dev. ``--jax-profile DIR``
+brackets the measured window with ``jax.profiler`` for device-side
+attribution on real hardware (the v5e campaign artifact). Closed-loop
+trials also record the LIVE p50/p99 pod-to-bind gauges (the P-squared
+sketch behind ``scheduler_pod_to_bind_quantile_seconds``) next to the
+bench-computed percentiles, so the streaming estimate is checked
+against ground truth every run.
 """
 
 from __future__ import annotations
@@ -609,7 +621,15 @@ def run_open_loop_bench(args) -> None:
     ]
 
     from kubernetes_tpu.testing import make_pod
+    from kubernetes_tpu.utils import flightrecorder
 
+    jprof = _JaxProfileWindow(args.jax_profile)
+    if args.trace:
+        # arm the Chrome-trace buffer for the whole ladder: stage spans
+        # per thread, device solves, arrival stalls, and the adaptive
+        # policy's autobatch instant events all land on one timeline
+        flightrecorder.start_trace()
+    jprof.start()
     per_policy = {}
     for policy in policies:
         server, client, informers, sched, controller = _open_loop_stack(
@@ -653,7 +673,8 @@ def run_open_loop_bench(args) -> None:
             # same (kind, rate, seed) per rung across policies: the
             # policies see IDENTICAL arrival instants
             offsets = load_trace(
-                args.trace, rate, step_s, seed=args.trace_seed + idx,
+                args.arrival_trace, rate, step_s,
+                seed=args.trace_seed + idx,
                 replay_path=args.trace_replay,
             )
             if offsets.size == 0:
@@ -687,6 +708,13 @@ def run_open_loop_bench(args) -> None:
             "steps": steps,
         }
 
+    jprof.stop()
+    if args.trace:
+        n_events = flightrecorder.export_chrome_trace(args.trace)
+        print(
+            f"chrome trace: {n_events} events -> {args.trace}",
+            file=sys.stderr,
+        )
     headline_policy = "adaptive" if "adaptive" in per_policy else policies[0]
     headline = per_policy[headline_policy]
     record = {
@@ -696,7 +724,7 @@ def run_open_loop_bench(args) -> None:
         "unit": "pods/s",
         "policy": headline_policy,
         "slo_p99_ms": args.slo_p99_ms,
-        "trace": args.trace,
+        "trace": args.arrival_trace,
         "trace_seed": args.trace_seed,
         "step_seconds": step_s,
         "rates": rates,
@@ -894,6 +922,41 @@ def _stage_delta(sched, before):
     }
 
 
+class _JaxProfileWindow:
+    """Bracket the measured window with jax.profiler traces when
+    --jax-profile DIR is set (no-op otherwise; profiler import/start
+    failures degrade to a warning so a CPU box still benches)."""
+
+    def __init__(self, log_dir: str) -> None:
+        self.log_dir = log_dir
+        self._active = False
+
+    def start(self) -> None:
+        if not self.log_dir:
+            return
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        except Exception as e:  # noqa: BLE001 - observability only
+            print(f"jax profiler unavailable: {e}", file=sys.stderr)
+
+    def stop(self) -> None:
+        if not self._active:
+            return
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            print(
+                f"jax profile written to {self.log_dir}", file=sys.stderr
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"jax profiler stop failed: {e}", file=sys.stderr)
+        self._active = False
+
+
 def run_burst_trial(sched, client, server, num_pods, trial):
     """One measured 10k-pod burst through the warmed stack. Returns a
     per-trial record or raises AssertionError when pods don't complete.
@@ -906,8 +969,12 @@ def run_burst_trial(sched, client, server, num_pods, trial):
     trajectory without a --profile re-run. ``--profile`` only adds the
     per-pod classify timer."""
     from kubernetes_tpu.testing import make_pod
-    from kubernetes_tpu.utils import timeline
+    from kubernetes_tpu.utils import metrics, timeline
 
+    # fresh live-quantile window per trial: the recorded live p50/p99
+    # below then answer for THIS trial's distribution, directly
+    # comparable to the bench-computed percentiles from the watch
+    metrics.pod_to_bind_sketch.reset()
     burst = [
         make_pod(f"burst-t{trial}-{i}")
         .container(cpu="250m", memory="512Mi")
@@ -977,6 +1044,19 @@ def run_burst_trial(sched, client, server, num_pods, trial):
         "elapsed_s": round(elapsed, 3),
         "p50_pod_to_bind_ms": round(p50 * 1000, 1),
         "p99_pod_to_bind_ms": round(p99 * 1000, 1),
+        # the live streaming estimate the /metrics gauges expose
+        # (scheduler_pod_to_bind_quantile_seconds), recorded next to
+        # the exact bench percentiles as its standing accuracy check.
+        # Clock note: the sketch measures first-queue-attempt -> bind
+        # on the scheduler side; the bench measures create -> watch
+        # confirmation -- in-process those differ by informer delivery,
+        # small against the burst's queueing delay.
+        "live_p50_pod_to_bind_ms": round(
+            metrics.pod_to_bind_sketch.value(0.5) * 1000, 1
+        ),
+        "live_p99_pod_to_bind_ms": round(
+            metrics.pod_to_bind_sketch.value(0.99) * 1000, 1
+        ),
         "profile_stage_seconds": _stage_delta(sched, stage_before),
     }
     return record
@@ -1004,9 +1084,25 @@ def main() -> None:
         "horizontal scale-out headline",
     )
     ap.add_argument(
-        "--trace", default=os.environ.get("OPEN_LOOP_TRACE", "poisson"),
+        "--arrival-trace",
+        default=os.environ.get("OPEN_LOOP_TRACE", "poisson"),
         choices=("poisson", "bursty", "diurnal", "replay"),
-        help="open-loop arrival trace kind (streaming/arrivals.py)",
+        help="open-loop arrival trace kind (streaming/arrivals.py); "
+        "was --trace before the Chrome-trace exporter took that name",
+    )
+    ap.add_argument(
+        "--trace", default=os.environ.get("BENCH_TRACE_OUT", ""),
+        metavar="OUT.json",
+        help="write the measured window as Chrome-trace/Perfetto JSON "
+        "(host stage spans per thread + device solve spans + arrival "
+        "stalls + autobatch instant events); load at ui.perfetto.dev",
+    )
+    ap.add_argument(
+        "--jax-profile", default=os.environ.get("BENCH_JAX_PROFILE", ""),
+        metavar="DIR",
+        help="bracket the measured window with jax.profiler traces "
+        "written to DIR (device-side attribution for the real-hardware "
+        "campaign; no-op when the profiler is unavailable)",
     )
     ap.add_argument(
         "--trace-seed", type=int,
@@ -1160,15 +1256,33 @@ def main() -> None:
     # capture cannot move the recorded numbers.
     num_trials = max(1, args.trials)
     trials = []
+    from kubernetes_tpu.utils import flightrecorder
+
+    jprof = _JaxProfileWindow(args.jax_profile)
     try:
         for trial in range(num_trials + 1):
+            if trial == 1:
+                # measured window starts here (trial 0 is the
+                # discarded warmup): arm the Chrome-trace buffer and
+                # the jax profiler bracket
+                if args.trace:
+                    flightrecorder.start_trace()
+                jprof.start()
             rec = run_burst_trial(sched, client, server, num_pods, trial)
             if trial == 0:
                 rec["discarded_warmup"] = True
                 print(json.dumps(rec), file=sys.stderr)
                 continue
             trials.append(rec)
+        jprof.stop()
+        if args.trace:
+            n_events = flightrecorder.export_chrome_trace(args.trace)
+            print(
+                f"chrome trace: {n_events} events -> {args.trace}",
+                file=sys.stderr,
+            )
     except AssertionError as e:
+        jprof.stop()
         sched.stop()
         informers.stop()
         print(
@@ -1200,6 +1314,10 @@ def main() -> None:
         "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
         "p50_pod_to_bind_ms": median["p50_pod_to_bind_ms"],
         "p99_pod_to_bind_ms": median["p99_pod_to_bind_ms"],
+        # the live streaming gauges next to the exact percentiles: the
+        # standing accuracy check for the P-squared sketch
+        "live_p50_pod_to_bind_ms": median.get("live_p50_pod_to_bind_ms"),
+        "live_p99_pod_to_bind_ms": median.get("live_p99_pod_to_bind_ms"),
         "median_trial": median["trial"],
         "trials": trials,
         # always present (stage timers are always on): the recorded

@@ -755,6 +755,30 @@ def mesh_packed_cache_size(mesh) -> int:
     return int(fn._cache_size())
 
 
+def jit_cache_sizes(mesh=None) -> dict:
+    """Compiled-signature counts of every jitted solver family the
+    dispatch path can hit, keyed by a stable signature-family name.
+
+    The runtime jit-cache watchdog (scheduler/batch.py) diffs this per
+    batch: growth books ``scheduler_tpu_jit_compiles_total{signature}``
+    and, once warmup has sealed the cache, fires a flight-recorder mark
+    -- the production generalization of the test-only
+    ``mesh_packed_cache_size`` probe. O(1) per family (a dict __len__
+    on the jit cache), cheap enough to run after every solve."""
+    out = {}
+    for name, fn in (
+        ("solve_packed", _solve_packed_jit),
+        ("greedy_compact", greedy_assign_compact),
+        ("greedy_constrained", greedy_assign_constrained),
+    ):
+        probe = getattr(fn, "_cache_size", None)
+        if probe is not None:
+            out[name] = int(probe())
+    if mesh is not None:
+        out["mesh_packed"] = mesh_packed_cache_size(mesh)
+    return out
+
+
 @jax.jit
 def apply_assignment_delta(
     req_state: jnp.ndarray,  # [N, R] int32 device-resident

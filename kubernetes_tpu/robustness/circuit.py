@@ -25,7 +25,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional, TypeVar
 
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import flightrecorder, metrics
 
 T = TypeVar("T")
 
@@ -90,6 +90,10 @@ class CircuitBreaker:
             return
         metrics.breaker_transitions.inc(
             tier=self.tier, from_state=self._state, to_state=to_state
+        )
+        flightrecorder.mark(
+            "breaker", tier=self.tier, from_state=self._state,
+            to_state=to_state,
         )
         self._state = to_state
         if to_state == OPEN:

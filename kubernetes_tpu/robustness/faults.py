@@ -21,7 +21,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import flightrecorder, metrics
 
 
 class FaultPoint:
@@ -161,6 +161,10 @@ class FaultInjector:
                 self._fired[point] += 1
         if fire:
             metrics.faults_injected.inc(point=point)
+            # the chaos e2e reconstructs "which faults fired, in what
+            # order" from the flight recorder alone and checks it
+            # against this injector's own ledger (fired_count)
+            flightrecorder.mark("fault", point=point)
         return fire
 
     def fired_count(self, point: str) -> int:

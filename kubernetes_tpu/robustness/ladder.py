@@ -35,7 +35,7 @@ from kubernetes_tpu.robustness.circuit import (
     SolveTimeout,
     Watchdog,
 )
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import flightrecorder, metrics
 
 T = TypeVar("T")
 
@@ -142,6 +142,11 @@ class SolverLadder:
                     tier=self._next_tier_name(attempts, idx),
                     reason=f"{tier}_breaker_open",
                 )
+                flightrecorder.mark(
+                    "fallback",
+                    tier=self._next_tier_name(attempts, idx),
+                    reason=f"{tier}_breaker_open",
+                )
                 continue
             try:
                 result = self._attempt_tier(tier, thunk)
@@ -155,12 +160,22 @@ class SolverLadder:
                     tier=self._next_tier_name(attempts, idx),
                     reason=f"{tier}_timeout",
                 )
+                flightrecorder.mark(
+                    "fallback",
+                    tier=self._next_tier_name(attempts, idx),
+                    reason=f"{tier}_timeout",
+                )
                 continue
             except Exception as e:  # noqa: BLE001 - any failure steps down
                 last_error = e
                 if breaker is not None:
                     breaker.record_failure()
                 metrics.solver_fallbacks.inc(
+                    tier=self._next_tier_name(attempts, idx),
+                    reason=f"{tier}_error",
+                )
+                flightrecorder.mark(
+                    "fallback",
                     tier=self._next_tier_name(attempts, idx),
                     reason=f"{tier}_error",
                 )

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import json
 import logging
+import signal
 import threading
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -25,7 +26,7 @@ from kubernetes_tpu.scheduler.resilience import (
     recover_on_startup,
 )
 from kubernetes_tpu.scheduler.scheduler import Scheduler, new_scheduler
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import flightrecorder, metrics
 
 logger = logging.getLogger(__name__)
 
@@ -56,6 +57,14 @@ class _OpsHandler(BaseHTTPRequestHandler):
             metrics.cache_size.set(self.app.sched.cache.pod_count(), type="pods")
             self._reply(
                 200, metrics.registry.expose(), "text/plain; version=0.0.4"
+            )
+        elif self.path == "/debug/flightrecorder":
+            # the last-K batch spans + control-plane marks, as JSON:
+            # chaos e2es and operators reconstruct "what happened to
+            # batch N" from here instead of grepping logs
+            self._reply(
+                200, flightrecorder.RECORDER.dump_json(indent=1),
+                "application/json",
             )
         elif self.path == "/debug/cache":
             self._reply(200, self.app.debugger.dumper.dump_all())
@@ -156,6 +165,18 @@ class SchedulerApp:
     # -- run (server.go:164) -------------------------------------------------
 
     def start(self) -> None:
+        # SIGUSR1 -> flight-recorder dump to disk (the kill -USR1 "what
+        # is it doing right now" probe); only installable from the main
+        # thread, and never required for correctness
+        try:
+            signal.signal(
+                signal.SIGUSR1,
+                lambda signum, frame: flightrecorder.RECORDER.dump_to_file(
+                    "sigusr1"
+                ),
+            )
+        except (ValueError, AttributeError, OSError):
+            pass  # non-main thread or platform without SIGUSR1
         if self.coordinator is not None:
             # claim partitions BEFORE the informers sync so the event
             # handlers filter the very first frames against a live

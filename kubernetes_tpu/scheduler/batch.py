@@ -102,6 +102,7 @@ from kubernetes_tpu.robustness.ladder import (
 from kubernetes_tpu.scheduler.generic import SNAPSHOT_STATE_KEY
 from kubernetes_tpu.scheduler.scheduler import Scheduler
 from kubernetes_tpu.tensors import NodeTensorCache, pack_pod_batch
+from kubernetes_tpu.utils import flightrecorder
 from kubernetes_tpu.utils import metrics
 from kubernetes_tpu.utils import timeline
 
@@ -165,6 +166,51 @@ class _EagerDownload:
         return self._value
 
 logger = logging.getLogger(__name__)
+
+
+class _JitCacheWatch:
+    """Runtime jit-cache watchdog: diff the solver families' compiled-
+    signature counts after each solve. Every growth books
+    ``scheduler_tpu_jit_compiles_total{signature}``; growth after
+    ``seal()`` (end of warmup) is a MID-RUN recompile and additionally
+    fires a flight-recorder mark + warning -- the production
+    generalization of the dryrun's test-only ``mesh_packed_cache_size``
+    probe. O(families) dict reads per batch."""
+
+    __slots__ = ("_mesh", "_last", "_sealed")
+
+    def __init__(self, mesh=None) -> None:
+        self._mesh = mesh
+        self._last: dict = {}
+        self._sealed = False
+
+    def seal(self) -> None:
+        """Warmup is done: from here, cache growth is unplanned."""
+        self.refresh()
+        self._sealed = True
+
+    def refresh(self) -> None:
+        from kubernetes_tpu.ops.assignment import jit_cache_sizes
+
+        try:
+            sizes = jit_cache_sizes(self._mesh)
+        except Exception:  # pragma: no cover - probe must never break solves
+            return
+        for sig, n in sizes.items():
+            prev = self._last.get(sig, 0)
+            if n > prev:
+                metrics.jit_compiles.inc(n - prev, signature=sig)
+                if self._sealed:
+                    flightrecorder.mark(
+                        "jit_recompile", signature=sig, cache_size=n,
+                        compiles=n - prev,
+                    )
+                    logger.warning(
+                        "mid-run jit recompile: %s cache grew %d -> %d",
+                        sig, prev, n,
+                    )
+            self._last[sig] = n
+
 
 POD_BUCKET = 64  # batch padded to a multiple of this to bound re-JITs
 #: constrained batches above this node capacity take the sequential host
@@ -449,6 +495,17 @@ class BatchScheduler(Scheduler):
         self._stage_lock = threading.Lock()
         self._stage_local = threading.local()
         self._stage_dicts: List[dict] = []
+        # flight-recorder spine (utils/flightrecorder.py): the pop-side
+        # stage timings of the CURRENT drain, consumed by the first
+        # span it dispatches (pop_batch drains before the flush loop
+        # splits batches, so the pop cost belongs to the drain's head)
+        # (drain-work seconds, arrival-wait seconds, pop-start
+        # perf_counter) of the current drain
+        self._pop_note: Optional[Tuple[float, float, float]] = None
+        # runtime jit-cache watchdog: sealed at the end of warmup();
+        # unsealed growth still counts compiles, it just isn't flagged
+        # as a mid-run recompile (tests that skip warmup stay quiet)
+        self._jit_watch = _JitCacheWatch(mesh)
         # collect-at-idle gc policy, engaged only by the production run
         # loop (tests driving schedule_batch directly keep gc untouched)
         self._gc_guard = None
@@ -508,6 +565,8 @@ class BatchScheduler(Scheduler):
         self._stage_add("pop_batch", max(0.0, dt_pop - waited))
         if waited:
             self._stage_add("pop_wait", waited)
+        # the first span this drain dispatches claims the pop timings
+        self._pop_note = (max(0.0, dt_pop - waited), waited, t_pop)
         guard = self._gc_guard
         if not batch_infos:
             # idle: finish whatever is still in flight
@@ -1052,6 +1111,7 @@ class BatchScheduler(Scheduler):
                 metrics.degraded_health.set(
                     1, reason="committer_join_timeout"
                 )
+                flightrecorder.dump_on_degraded("committer_join_timeout")
                 self.commit_degraded = True
             self._committer = None
 
@@ -1377,6 +1437,7 @@ class BatchScheduler(Scheduler):
                     ds.req_shadow[fix_rows] = node_requested[fix_rows]
                     ds.nzr_shadow[fix_rows] = node_nzr[fix_rows]
                     self.carry_divergences += 1
+                    metrics.carry_divergences.inc()
                 if member.size:
                     self.membership_row_patches += int(member.size)
                 ds.validated_epoch = d.epoch
@@ -1394,6 +1455,7 @@ class BatchScheduler(Scheduler):
             # upload path
             if diverged:
                 self.carry_divergences += 1
+                metrics.carry_divergences.inc()
             static_ok = not static_full and alloc_rows.size == 0
             if not static_ok:
                 ds.layout_epoch = (
@@ -1426,6 +1488,33 @@ class BatchScheduler(Scheduler):
         incompatible clusters) drain the pipeline first."""
         timeline.mark(f"dispatch_start b={len(solver_infos)}")
         t_pack = time.perf_counter()
+        # -- flight-recorder span: one per dispatch (a gang re-solve or
+        # drain-redispatch is honestly its own span), with the per-pod
+        # linkage (uid -> batch id, queue-wait, attempts) that makes a
+        # pod's whole pod-to-bind path one join
+        if flightrecorder.ENABLED:
+            now_m = time.monotonic()
+            span = flightrecorder.begin_batch(
+                len(solver_infos),
+                pods=[
+                    (pi.pod.metadata.uid,
+                     max(0.0, now_m - pi.timestamp), pi.attempts)
+                    for pi in solver_infos
+                ],
+            )
+            pop_note = self._pop_note
+            if pop_note is not None:
+                self._pop_note = None
+                work, pop_waited, t_pop0 = pop_note
+                # the drain blocks for arrivals first, then drains:
+                # wait span at t_pop0, work span after it
+                if pop_waited:
+                    span.stage("pop_wait", pop_waited, t0=t_pop0)
+                span.stage("pop_batch", work, t0=t_pop0 + pop_waited)
+            if inactive_uids:
+                span.note(gang_redispatch=True)
+        else:
+            span = flightrecorder.NULL_SPAN
         pods = [pi.pod for pi in solver_infos]
         # batch-level constraint aggregates from the cached admission
         # feature bits (scheduler/admission.py): any() over memo reads
@@ -1560,6 +1649,9 @@ class BatchScheduler(Scheduler):
             # side).
             self._drain_pending()
             self.nominee_constrained_fallbacks += 1
+            span.finish(
+                tier=TIER_SEQUENTIAL, routed="nominee_constrained"
+            )
             for pi in solver_infos:
                 self.pods_fallback += 1
                 self.attempt_schedule(pi)
@@ -1712,6 +1804,7 @@ class BatchScheduler(Scheduler):
             # must include every in-flight placement
             self.envelope_fallbacks += 1
             self._drain_pending()
+            span.finish(tier=TIER_SEQUENTIAL, routed="score_envelope")
             for pi in solver_infos:
                 self.pods_fallback += 1
                 self.attempt_schedule(pi)
@@ -1724,6 +1817,9 @@ class BatchScheduler(Scheduler):
             if spread is None:
                 # envelope exceeded: host path keeps full correctness
                 self.envelope_fallbacks += 1
+                span.finish(
+                    tier=TIER_SEQUENTIAL, routed="spread_envelope"
+                )
                 for pi in solver_infos:
                     self.pods_fallback += 1
                     self.attempt_schedule(pi)
@@ -1736,6 +1832,9 @@ class BatchScheduler(Scheduler):
                 # correctness -- port-only batches fall through to the
                 # port-row builder instead
                 self.envelope_fallbacks += 1
+                span.finish(
+                    tier=TIER_SEQUENTIAL, routed="affinity_envelope"
+                )
                 for pi in solver_infos:
                     self.pods_fallback += 1
                     self.attempt_schedule(pi)
@@ -1753,12 +1852,18 @@ class BatchScheduler(Scheduler):
                     # port-only batch may not have drained above)
                     self._drain_pending()
                     self.envelope_fallbacks += 1
+                    span.finish(
+                        tier=TIER_SEQUENTIAL, routed="port_envelope"
+                    )
                     for pi in solver_infos:
                         self.pods_fallback += 1
                         self.attempt_schedule(pi)
                     return None
 
-        self._stage_add("pack", time.perf_counter() - t_pack)
+        dt_pack = time.perf_counter() - t_pack
+        self._stage_add("pack", dt_pack)
+        span.stage("pack", dt_pack, t0=t_pack)
+        span.note(padded=padded)
         solve_timer = metrics.SinceTimer(metrics.batch_solve_duration)
 
         # preemption prewarm: when the batch's most demanding request
@@ -1782,6 +1887,9 @@ class BatchScheduler(Scheduler):
         if constrained and nt.capacity > CONSTRAINED_NODE_CAP:
             self._drain_pending()
             self.envelope_fallbacks += 1
+            span.finish(
+                tier=TIER_SEQUENTIAL, routed="constrained_node_cap"
+            )
             for pi in solver_infos:
                 self.pods_fallback += 1
                 self.attempt_schedule(pi)
@@ -1803,12 +1911,21 @@ class BatchScheduler(Scheduler):
             # failure, dead carry): land them, then redo this dispatch
             # from the fresh host state
             self._drain_pending()
+            span.finish(routed="drain_redispatch")
             return self._dispatch_solve(
                 solver_infos, pod_scheduling_cycle,
                 inactive_uids=inactive_uids,
             )
         static_ok = neg["static_ok"]
         carry_ok = neg["carry_ok"]
+        span.note(
+            carry=(
+                "delta" if carry_ok and (
+                    neg["didx"].size or neg["sidx"].size
+                ) else "reuse" if carry_ok else "upload"
+            ),
+            delta_rows=int(neg["didx"].size + neg["sidx"].size),
+        )
         if self.mesh is None or self.mesh_delta:
             # single-buffer upload: over the serving link every device_put
             # operand pays its own round trip (~40-90ms each); the whole
@@ -1952,9 +2069,18 @@ class BatchScheduler(Scheduler):
                     tier, out = self.ladder.run(
                         attempts, label=f"batch b={b}"
                     )
-                self._stage_add(
-                    "device_solve", time.perf_counter() - t_solve
-                )
+                dt_solve = time.perf_counter() - t_solve
+                self._stage_add("device_solve", dt_solve)
+                span.stage("device_solve", dt_solve, t0=t_solve)
+                if flightrecorder.trace_active():
+                    # the device's own track, next to the host threads
+                    flightrecorder.trace_span(
+                        f"solve b={b}", t_solve, dt_solve,
+                        track="device",
+                        args={"batch": span.batch_id, "tier": tier}
+                        if span else None,
+                    )
+                self._jit_watch.refresh()
             except LadderExhausted:
                 with self._shadow_lock:
                     ds.invalidate_carry()
@@ -1988,12 +2114,20 @@ class BatchScheduler(Scheduler):
                     # state with the breakers now routing around the
                     # sick tiers
                     self._drain_pending()
+                    span.finish(routed="exhausted_redispatch")
                     return self._dispatch_solve(
                         solver_infos, pod_scheduling_cycle,
                         inactive_uids=inactive_uids,
                     )
                 metrics.solver_fallbacks.inc(
                     tier=TIER_SEQUENTIAL, reason="ladder_exhausted"
+                )
+                flightrecorder.mark(
+                    "fallback", tier=TIER_SEQUENTIAL,
+                    reason="ladder_exhausted",
+                )
+                span.finish(
+                    tier=TIER_SEQUENTIAL, routed="ladder_exhausted"
                 )
                 self.ladder.record_sequential(len(solver_infos))
                 logger.warning(
@@ -2044,6 +2178,18 @@ class BatchScheduler(Scheduler):
                     else:
                         ds.invalidate_carry()
             else:
+                # a jitted solve LANDED: the booked upload / scatter is
+                # established device state -- mirror the internal
+                # counters into the (monotonic) Prometheus series now,
+                # when the booking is final (the host-tier / exhausted
+                # branches un-book the attributes and book nothing here)
+                if carry_ok:
+                    if neg["didx"].size or neg["sidx"].size:
+                        metrics.delta_rows_uploaded.inc(
+                            int(neg["didx"].size + neg["sidx"].size)
+                        )
+                else:
+                    metrics.state_uploads.inc()
                 if not static_ok:
                     ds.alloc_dev, ds.valid_dev = alloc_out, valid_out
                 elif neg["sidx"].size:
@@ -2059,9 +2205,11 @@ class BatchScheduler(Scheduler):
                     ds.invalidate_carry()
                 else:
                     ds.req_dev, ds.nzr_dev = req_out, nzr_out
+            span.note(tier=tier)
             return {
                 "tier": tier,
                 "carry_in": carry_in,
+                "span": span,
                 "solver_infos": list(solver_infos),
                 "has_required_anti": has_required_anti,
                 "has_ports": batch_ports,
@@ -2132,7 +2280,10 @@ class BatchScheduler(Scheduler):
             assignments_dev, req_out, nzr_out = self._mesh_solve(
                 common_args, spread, affinity, score_batch, padded, nt
             )
-            self._stage_add("device_solve", time.perf_counter() - t_solve)
+            dt_solve = time.perf_counter() - t_solve
+            self._stage_add("device_solve", dt_solve)
+            span.stage("device_solve", dt_solve, t0=t_solve)
+            self._jit_watch.refresh()
         except Exception:
             # mesh path: no pallas/host tier distinction -- a failed
             # sharded solve steps straight down to the sequential oracle
@@ -2140,6 +2291,11 @@ class BatchScheduler(Scheduler):
             metrics.solver_fallbacks.inc(
                 tier=TIER_SEQUENTIAL, reason="mesh_solve_error"
             )
+            flightrecorder.mark(
+                "fallback", tier=TIER_SEQUENTIAL,
+                reason="mesh_solve_error",
+            )
+            span.finish(tier=TIER_SEQUENTIAL, routed="mesh_solve_error")
             with self._shadow_lock:
                 ds.invalidate_carry()
             self._drain_pending()
@@ -2148,6 +2304,8 @@ class BatchScheduler(Scheduler):
                 self.pods_fallback += 1
                 self.attempt_schedule(pi)
             return None
+        if not carry_ok:
+            metrics.state_uploads.inc()
         # start the result transfer now so it overlaps host commit work
         try:
             assignments_dev.copy_to_host_async()
@@ -2160,11 +2318,13 @@ class BatchScheduler(Scheduler):
         else:
             ds.req_dev, ds.nzr_dev = req_out, nzr_out
 
+        span.note(tier=TIER_XLA)
         return {
             "tier": TIER_XLA,  # mesh solves are plain XLA lowerings
             "carry_in": (
                 (req_state_d, nzr_state_d) if carry_ok else None
             ),
+            "span": span,
             "download": self._eager_download(assignments_dev),
             # copy: the caller's list is cleared after dispatch returns
             "solver_infos": list(solver_infos),
@@ -2268,19 +2428,27 @@ class BatchScheduler(Scheduler):
                 return eager.result()
             return np.asarray(p["assignments_dev"])
 
+        fspan = p.get("span") or flightrecorder.NULL_SPAN
         try:
             t_dl = time.perf_counter()
             with timeline.span("download"):
                 assignments = self.ladder.watchdog.call(
                     download, timeout, tier=tier
                 )
-            self._stage_add("download", time.perf_counter() - t_dl)
+            dt_dl = time.perf_counter() - t_dl
+            self._stage_add("download", dt_dl)
+            fspan.stage("download", dt_dl, t0=t_dl)
         except SolveTimeout:
             if breaker is not None:
                 breaker.force_open()
             metrics.solver_fallbacks.inc(
                 tier=TIER_SEQUENTIAL, reason=f"{tier}_download_timeout"
             )
+            flightrecorder.mark(
+                "fallback", tier=TIER_SEQUENTIAL,
+                reason=f"{tier}_download_timeout",
+            )
+            fspan.finish(routed="download_timeout")
             raise
         except Exception:
             if breaker is not None:
@@ -2301,6 +2469,11 @@ class BatchScheduler(Scheduler):
             metrics.solver_fallbacks.inc(
                 tier=TIER_SEQUENTIAL, reason=f"{tier}_garbage_result"
             )
+            flightrecorder.mark(
+                "fallback", tier=TIER_SEQUENTIAL,
+                reason=f"{tier}_garbage_result",
+            )
+            fspan.finish(routed="garbage_result")
             raise RuntimeError(
                 f"solve on tier {tier!r} returned out-of-range "
                 f"assignments; discarding the batch result"
@@ -2334,8 +2507,12 @@ class BatchScheduler(Scheduler):
                 p["num_nodes"], p["snapshot"], p["cycle"],
                 mask_info=(p.get("mask_rows"), p.get("mask_index_solved")),
                 gang_failed_uids=p.get("gang_failed_uids"),
+                span=fspan,
             )
-        self._stage_add("commit", time.perf_counter() - t_commit)
+        dt_commit = time.perf_counter() - t_commit
+        self._stage_add("commit", dt_commit)
+        fspan.stage("commit", dt_commit, t0=t_commit)
+        fspan.finish()
         if (
             self._prewarm_next_commit
             and not self._deferred_preempt
@@ -2360,6 +2537,7 @@ class BatchScheduler(Scheduler):
         pod_scheduling_cycle: int,
         mask_info=None,
         gang_failed_uids=None,
+        span=None,
     ) -> None:
         """Post-solve pipeline for the whole batch: Reserve -> assume ->
         Permit (scheduler.go:615-660 semantics preserved), then ONE async
@@ -2372,6 +2550,8 @@ class BatchScheduler(Scheduler):
         are assumed in one bulk cache transaction -- the batch commit is
         otherwise the profile-run hot loop of the 10k burst."""
         b = len(solver_infos)
+        if span is None:
+            span = flightrecorder.NULL_SPAN
         # schedule_batch flushes at profile boundaries, so the whole batch
         # shares one profile (batch.py:242)
         prof = self.profiles.get(solver_infos[0].pod.spec.scheduler_name)
@@ -2459,6 +2639,7 @@ class BatchScheduler(Scheduler):
                     # PodGroupMemberAdd wakeup retries once the group
                     # can assemble)
                     metrics.schedule_attempts.inc(result="unschedulable")
+                    span.bump("gang_masked")
                     self.record_scheduling_failure(
                         prof, pi,
                         "pod group cannot reach minMember this cycle",
@@ -2535,6 +2716,7 @@ class BatchScheduler(Scheduler):
                     for pi, assumed, host in zip(plain_pis, clones, hosts)
                 ]
             self.pods_solved_on_device += len(plain_pis)
+            span.bump("placed", len(plain_pis))
 
         failed_group: List[Tuple[PodInfo, FitError]] = []
         cluster_anti = None
@@ -2561,6 +2743,7 @@ class BatchScheduler(Scheduler):
                         "volume-count-reject"
                     )
                     self.volume_reject_retries += 1
+                    span.bump("volume_retries")
                     self.record_scheduling_failure(
                         prof, pi,
                         "countable-volume pod rejected by the device "
@@ -2577,11 +2760,13 @@ class BatchScheduler(Scheduler):
                     # through the apiserver, so preemption and backoff
                     # wait until every partition has had a look
                     self.pods_solved_on_device += 1
+                    span.bump("spilled")
                     continue
             state = CycleState()
             state.write(SNAPSHOT_STATE_KEY, snapshot)
             if choice == NO_NODE:
                 metrics.schedule_attempts.inc(result="unschedulable")
+                span.bump("no_node")
                 # per-node reason codes (SURVEY section 7 hardest-part d,
                 # generic_scheduler.go:1033): nodes rejected by the
                 # STATIC mask (label/taint/name/unschedulable mismatch)
@@ -2652,6 +2837,7 @@ class BatchScheduler(Scheduler):
             self.pods_solved_on_device += 1
             if assumed is None:
                 continue
+            span.bump("placed")
             waiting = prof.get_waiting_pod(assumed.metadata.uid) is not None
             binder_extender = any(
                 e.is_binder() and e.is_interested(assumed)
@@ -2721,7 +2907,7 @@ class BatchScheduler(Scheduler):
                 self._inflight_binds += 1
             self._bind_pool.submit(
                 self._bulk_binding_cycle_safe, bulk, pod_scheduling_cycle,
-                snapshot,
+                snapshot, span,
             )
         for prof_d, state_d, pi_d, assumed_d, host_d in deferred:
             self._binding_cycle(
@@ -2755,6 +2941,12 @@ class BatchScheduler(Scheduler):
                 logger.exception("batched device preemption failed")
                 nominated = [""] * len(items)
             evict_ok = victim_uids is not None
+            flightrecorder.mark(
+                "preemption_wave", pods=len(items),
+                nominated=sum(1 for n in nominated if n),
+                victims=len(victim_uids or ()),
+                tier=getattr(self.preemptor, "wave_solver_tier", ""),
+            )
             # wait (bounded) for the evictions to propagate from the
             # watch into the cache: the nominated pods retry WITHOUT
             # backoff below -- their failure was just resolved by this
@@ -2833,7 +3025,8 @@ class BatchScheduler(Scheduler):
                 )
 
     def _absorb_bind_conflict(
-        self, prof, state, pi, assumed, host, err, pod_scheduling_cycle
+        self, prof, state, pi, assumed, host, err, pod_scheduling_cycle,
+        span=None,
     ) -> None:
         """Absorb one typed bind conflict into the ledger: forget the
         optimistic reservation, release plugin state, then route by
@@ -2846,6 +3039,11 @@ class BatchScheduler(Scheduler):
         kind = getattr(err, "kind", "already-bound")
         self.bind_conflicts_absorbed += 1
         metrics.bind_conflicts_absorbed.inc(kind=kind)
+        if span is not None:
+            span.bump("conflicts")
+        flightrecorder.mark(
+            "bind_conflict", conflict=kind, pod=assumed.metadata.uid,
+        )
         self._forget(assumed)
         prof.run_unreserve_plugins(state, assumed, host)
         live = None
@@ -2889,10 +3087,12 @@ class BatchScheduler(Scheduler):
             logger.exception("requeueing conflicted pod %s", pi.pod.key())
 
     def _bulk_binding_cycle_safe(
-        self, items, pod_scheduling_cycle, snapshot=None
+        self, items, pod_scheduling_cycle, snapshot=None, span=None
     ) -> None:
         try:
-            self._bulk_binding_cycle(items, pod_scheduling_cycle, snapshot)
+            self._bulk_binding_cycle(
+                items, pod_scheduling_cycle, snapshot, span
+            )
         except SchedulerCrashed:
             # simulated process death: halt with NO cleanup (the items
             # stay assumed-but-unbound; the next incarnation recovers)
@@ -2905,7 +3105,7 @@ class BatchScheduler(Scheduler):
                 self._inflight_lock.notify_all()
 
     def _bulk_binding_cycle(
-        self, items, pod_scheduling_cycle, snapshot=None
+        self, items, pod_scheduling_cycle, snapshot=None, span=None
     ) -> None:
         """One API transaction commits the batch (the pipelined bulk
         analogue of BindingREST.Create, storage.go:142). PreBind still
@@ -2966,6 +3166,7 @@ class BatchScheduler(Scheduler):
         # in the new leader's queue via its informers.
         if not self._fence_ok():
             metrics.fencing_aborts.inc()
+            flightrecorder.mark("fencing_abort", pods=len(ready))
             logger.warning(
                 "lease lost before bulk bind; fencing %d pod(s)",
                 len(ready),
@@ -2991,6 +3192,10 @@ class BatchScheduler(Scheduler):
             fenced = coord.fence_hosts([t[4] for t in ready])
             if fenced:
                 metrics.fencing_aborts.inc(len(fenced))
+                flightrecorder.mark(
+                    "fencing_abort", pods=len(fenced),
+                    fence="partition",
+                )
                 kept = []
                 fenced_pis = []
                 for i, item in enumerate(ready):
@@ -3002,6 +3207,12 @@ class BatchScheduler(Scheduler):
                     self.conflict_requeues += 1
                     metrics.bind_conflicts_absorbed.inc(
                         kind="partition-fence"
+                    )
+                    if span is not None:
+                        span.bump("conflicts")
+                    flightrecorder.mark(
+                        "bind_conflict", conflict="partition-fence",
+                        pod=assumed_f.metadata.uid,
                     )
                     self._forget(assumed_f)
                     prof_f.run_unreserve_plugins(
@@ -3048,6 +3259,7 @@ class BatchScheduler(Scheduler):
                         prof,
                         state if state is not None else mk_state(),
                         pi, assumed, host, err, pod_scheduling_cycle,
+                        span=span,
                     )
                     continue
                 metrics.schedule_attempts.inc(result="error")
@@ -3111,13 +3323,15 @@ class BatchScheduler(Scheduler):
             [pi.attempts for _, _, pi, _, _ in bound]
         )
         now = time.monotonic()
-        metrics.pod_scheduling_duration.observe_many(
-            [
-                max(0.0, now - pi.initial_attempt_timestamp)
-                for _, _, pi, _, _ in bound
-                if pi.initial_attempt_timestamp
-            ]
-        )
+        durations = [
+            max(0.0, now - pi.initial_attempt_timestamp)
+            for _, _, pi, _, _ in bound
+            if pi.initial_attempt_timestamp
+        ]
+        metrics.pod_scheduling_duration.observe_many(durations)
+        # live pod-to-bind quantile sketch (P-squared): the same stream
+        # the histogram sees, but queryable as p50/p99 gauges
+        metrics.observe_pod_to_bind(durations)
 
     # -- warmup --------------------------------------------------------------
 
@@ -3143,6 +3357,9 @@ class BatchScheduler(Scheduler):
         )
         for padded in [self.max_batch] + extra:
             self._warmup_at(nt, padded, full=padded == self.max_batch)
+        # seal the jit-cache watchdog: every signature compiled from
+        # here on is a mid-run recompile (counted AND flight-recorded)
+        self._jit_watch.seal()
         if self.autobatch is not None and hasattr(
             self.autobatch, "calibrate"
         ):

@@ -73,7 +73,7 @@ from kubernetes_tpu.api.types import (
 )
 from kubernetes_tpu.config.types import PartitionConfiguration
 from kubernetes_tpu.robustness.faults import FaultPoint, get_injector
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import flightrecorder, metrics
 
 logger = logging.getLogger(__name__)
 
@@ -571,6 +571,7 @@ class PartitionCoordinator:
         ):
             target = hint
             self.spill_hint_hits += 1
+            metrics.spill_hint_hits.inc()
         if target is None:
             # UNVISITED-first: a hint hop desynchronizes the ring, so
             # the walk must not burn the hop budget revisiting
@@ -780,6 +781,11 @@ class PartitionCoordinator:
                             detected if detected is not None else t_claim
                         )
                         metrics.partition_takeover_ms.observe(span * 1000.0)
+                        flightrecorder.mark(
+                            "partition_takeover", partition=k,
+                            by=self.identity,
+                            ms=round(span * 1000.0, 1),
+                        )
                         logger.warning(
                             "partition %d adopted by %s in %.0f ms",
                             k, self.identity, span * 1000.0,

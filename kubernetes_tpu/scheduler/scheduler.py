@@ -40,7 +40,7 @@ from kubernetes_tpu.robustness.faults import (
 )
 from kubernetes_tpu.scheduler.generic import GenericScheduler
 from kubernetes_tpu.scheduler.provider import default_plugins
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import flightrecorder, metrics
 
 logger = logging.getLogger(__name__)
 
@@ -216,6 +216,9 @@ class Scheduler:
     ) -> Optional[Status]:
         if not self._fence_ok():
             metrics.fencing_aborts.inc()
+            flightrecorder.mark(
+                "fencing_abort", pods=1, pod=assumed.metadata.uid
+            )
             return Status.error(
                 "lease lost before bind; commit fenced"
             )
@@ -226,6 +229,10 @@ class Scheduler:
             # committer; the binding cycle's failure path guarantees
             # forget + Unreserve + requeue
             metrics.fencing_aborts.inc()
+            flightrecorder.mark(
+                "fencing_abort", pods=1, pod=assumed.metadata.uid,
+                fence="partition",
+            )
             return Status.error(
                 f"partition of node {host} not held at bind; fenced"
             )
@@ -538,9 +545,11 @@ class Scheduler:
         # PodInfo timestamps come from the queue's monotonic clock
         now = time.monotonic()
         if pod_info.initial_attempt_timestamp:
-            metrics.pod_scheduling_duration.observe(
-                max(0.0, now - pod_info.initial_attempt_timestamp)
+            duration = max(
+                0.0, now - pod_info.initial_attempt_timestamp
             )
+            metrics.pod_scheduling_duration.observe(duration)
+            metrics.observe_pod_to_bind(duration)
         try:
             cycle_start = state.read("__cycle_start__")
         except KeyError:

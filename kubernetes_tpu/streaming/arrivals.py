@@ -33,7 +33,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import flightrecorder, metrics
 
 #: pods created per bulk API call when a burst of offsets is due at once
 #: (matches the chunked ingest the closed-loop bench uses)
@@ -266,6 +266,17 @@ class ArrivalEngine:
         stalled = time.perf_counter() - t0
         self.stall_seconds += stalled
         metrics.backpressure_stall_seconds.inc(stalled)
+        flightrecorder.mark(
+            "arrival_stall", seconds=round(stalled, 4),
+            stalls=self.backpressure_stalls,
+        )
+        # the --trace timeline gets the stall as a span on the
+        # arrival-engine track (a stalled engine means the offered rate
+        # did not actually enter the system -- that must be visible
+        # next to the solve spans it starves)
+        flightrecorder.trace_span(
+            "backpressure_stall", t0, stalled, track="arrival-engine",
+        )
 
     def _run(self) -> None:
         offsets = self._offsets

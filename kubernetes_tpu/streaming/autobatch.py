@@ -66,7 +66,7 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import flightrecorder, metrics
 
 #: batch sizes quantize to this (mirrors scheduler/batch.py POD_BUCKET
 #: without importing the scheduler -- the controller must stay
@@ -355,6 +355,19 @@ class AutoBatchController:
         metrics.autobatch_decisions.inc(direction=direction)
         metrics.autobatch_window.set(self.window)
         metrics.autobatch_batch_cap.set(float(self.batch_cap))
+        flightrecorder.mark(
+            "autobatch", direction=direction,
+            window_ms=round(self.window * 1000.0, 3),
+            cap=self.batch_cap,
+        )
+        # --trace timelines show controller moves as instant events on
+        # their own track, between the stage spans they retune
+        flightrecorder.trace_instant(
+            f"autobatch_{direction}",
+            args={"window_ms": round(self.window * 1000.0, 3),
+                  "cap": self.batch_cap},
+            track="autobatch",
+        )
         return direction
 
     # -- dispatcher-facing wrapper -------------------------------------------

@@ -396,6 +396,7 @@ class NodeTensorCache:
         self._row_member_epoch[i] = self._epoch
         heapq.heappush(self._free_rows, i)
         self.rows_retired += 1
+        _metrics.tensor_rows_retired.inc()
 
     def _claim_row(self) -> Optional[int]:
         """A slot for a new node: lowest free slot first, else the next
@@ -594,6 +595,7 @@ class NodeTensorCache:
             for i, ni in enumerate(infos):
                 self._pack_row(i, ni)
             self.full_repacks += 1
+            _metrics.tensor_full_repacks.inc()
             self.rows_repacked += len(infos)
             self._layout_epoch += 1
             self._row_member_epoch[:] = self._epoch
@@ -626,6 +628,7 @@ class NodeTensorCache:
                 self._pack_row(i, info_map[n])
                 self._row_member_epoch[i] = self._epoch
                 self.rows_added += 1
+                _metrics.tensor_rows_added.inc()
                 self.rows_repacked += 1
                 member_rows.append(i)
             self._node_count = len(infos)

@@ -22,8 +22,21 @@ def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted(labels.items()))
 
 
+def _escape_label_value(value) -> str:
+    """Prometheus text exposition escaping for label VALUES: backslash,
+    double-quote, and line-feed must be escaped or the emitted series is
+    unparseable (a fault-point name or node name containing a quote used
+    to corrupt the whole scrape)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _fmt_labels(key: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in key]
+    parts = [f'{k}="{_escape_label_value(v)}"' for k, v in key]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -61,10 +74,20 @@ class Gauge:
         label_names: Sequence[str] = (),
         fn: Optional[Callable[[], float]] = None,
     ):
+        if fn is not None and label_names:
+            # a bare ``fn`` cannot answer for a labeled family --
+            # collect() would emit an unlabeled sample under a labeled
+            # HELP/TYPE header (a malformed series). Per-label callbacks
+            # go through register_callback instead.
+            raise ValueError(
+                f"gauge {name!r}: a constructor callback cannot carry "
+                f"label_names; use register_callback(fn, **labels)"
+            )
         self.name = name
         self.help = help
         self.label_names = tuple(label_names)
-        self.fn = fn  # callback gauge
+        self.fn = fn  # callback gauge (unlabeled)
+        self._callbacks: Dict[Tuple, Callable[[], float]] = {}
         self._values: Dict[Tuple, float] = {}
         self._lock = threading.Lock()
 
@@ -72,9 +95,21 @@ class Gauge:
         with self._lock:
             self._values[_label_key(labels)] = value
 
+    def register_callback(
+        self, fn: Callable[[], float], **labels: str
+    ) -> None:
+        """Per-label-set callback: collect() calls ``fn`` at scrape
+        time for exactly this series (the labeled analogue of the
+        constructor ``fn``)."""
+        with self._lock:
+            self._callbacks[_label_key(labels)] = fn
+
     def value(self, **labels: str) -> float:
         if self.fn is not None:
             return self.fn()
+        cb = self._callbacks.get(_label_key(labels))
+        if cb is not None:
+            return cb()
         return self._values.get(_label_key(labels), 0.0)
 
     def collect(self) -> List[str]:
@@ -83,8 +118,15 @@ class Gauge:
             out.append(f"{self.name} {self.fn()}")
             return out
         with self._lock:
-            for key, v in sorted(self._values.items()):
-                out.append(f"{self.name}{_fmt_labels(key)} {v}")
+            callbacks = list(self._callbacks.items())
+            values = [
+                (key, v) for key, v in sorted(self._values.items())
+                if key not in self._callbacks
+            ]
+        for key, cb in sorted(callbacks):
+            out.append(f"{self.name}{_fmt_labels(key)} {cb()}")
+        for key, v in values:
+            out.append(f"{self.name}{_fmt_labels(key)} {v}")
         return out
 
 
@@ -476,6 +518,88 @@ partitions_held = registry.register(Gauge(
     "scheduler_partitions_held",
     "Partitions currently held by this stack's coordinator.",
 ))
+# tracing plane (ISSUE 13): the device-state counters that previously
+# lived only as bench/solver labels become real series -- a live
+# cluster sees what the bench sees -- plus the jit-cache watchdog and
+# the streaming pod-to-bind quantile gauges. Booking follows the PR-5
+# rule: link-traffic counters record what actually rode the link, so
+# state_uploads/delta_rows book only after a device solve LANDED (the
+# internal attributes un-book on ladder exhaustion / host-tier routing,
+# and a Prometheus counter cannot).
+state_uploads = registry.register(Counter(
+    "scheduler_tpu_state_uploads_total",
+    "Full [N, R] node-state uploads that reached the device (cold "
+    "dispatches, layout changes, escalated churn, counted divergence "
+    "resyncs).",
+))
+delta_rows_uploaded = registry.register(Counter(
+    "scheduler_tpu_delta_rows_uploaded_total",
+    "Changed node rows shipped as (indices, rows) scatters onto the "
+    "device-resident carry instead of full [N, R] uploads.",
+))
+carry_divergences = registry.register(Counter(
+    "scheduler_tpu_carry_divergences_total",
+    "Generation-handshake mismatches: host node state not explained by "
+    "our own mirrored placements (node churn, bind failures) -- "
+    "resolved by a row scatter-fix or a counted full upload, never "
+    "silently.",
+))
+tensor_full_repacks = registry.register(Counter(
+    "scheduler_tpu_tensor_full_repacks_total",
+    "NodeTensorCache full repacks (schema growth or slot-headroom "
+    "exhaustion; steady membership churn scatters in place instead).",
+))
+tensor_rows_added = registry.register(Counter(
+    "scheduler_tpu_tensor_rows_added_total",
+    "Node rows claimed in place by incremental node adds (free or "
+    "headroom slots; no layout move).",
+))
+tensor_rows_retired = registry.register(Counter(
+    "scheduler_tpu_tensor_rows_retired_total",
+    "Node rows freed in place by incremental node removals.",
+))
+spill_hint_hits = registry.register(Counter(
+    "scheduler_spill_hint_hits_total",
+    "Cross-partition spills routed straight to the owner partition by "
+    "the feasibility hint (one hop) instead of walking the ring.",
+))
+jit_compiles = registry.register(Counter(
+    "scheduler_tpu_jit_compiles_total",
+    "Jitted-solver cache growth observed by the runtime jit-cache "
+    "watchdog, by solver signature family. Growth after warmup sealed "
+    "the cache is a MID-RUN recompile: it also fires a flight-recorder "
+    "mark, because an unplanned multi-second compile inside a measured "
+    "window is exactly what the warmup contract exists to prevent.",
+    ("signature",),
+))
+pod_to_bind_quantile = registry.register(Gauge(
+    "scheduler_pod_to_bind_quantile_seconds",
+    "Live streaming estimate of the pod-to-bind latency quantile "
+    "(P-squared sketch over every bound pod's first-attempt-to-bind "
+    "wall clock), by quantile.",
+    ("q",),
+))
+
+from kubernetes_tpu.utils.quantiles import QuantileSet as _QuantileSet
+
+#: the live pod-to-bind sketch the gauges read at scrape time; the
+#: AutoBatchController can consume the same estimate
+pod_to_bind_sketch = _QuantileSet((0.5, 0.99))
+pod_to_bind_quantile.register_callback(
+    lambda: pod_to_bind_sketch.value(0.5), q="0.5"
+)
+pod_to_bind_quantile.register_callback(
+    lambda: pod_to_bind_sketch.value(0.99), q="0.99"
+)
+
+
+def observe_pod_to_bind(seconds) -> None:
+    """Feed the live quantile sketch (accepts a scalar or a sequence);
+    called from both bind paths next to pod_scheduling_duration."""
+    if isinstance(seconds, (int, float)):
+        pod_to_bind_sketch.observe(seconds)
+    else:
+        pod_to_bind_sketch.observe_many(seconds)
 
 
 class SinceTimer:

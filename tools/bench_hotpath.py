@@ -81,7 +81,16 @@ Prints ONE JSON line:
                          shared-log cursor design (PR 8) the 4-watcher
                          cost tracks the 1-watcher cost (broadcast is
                          O(events), watcher-count independent) and
-                         batched delivery beats per-event ~4x}
+                         batched delivery beats per-event ~4x,
+   "trace_{on,off}_hot_ms" / "trace_overhead_pct" /
+   "trace_{span,mark}_us":
+                         the ISSUE-13 flight-recorder spine on a real
+                         1k-pod closed-loop burst, recorder ON vs
+                         compiled-out (interleaved arms, best-of-2
+                         each; denominator = the pop+pack+solve+
+                         download+commit stage-timer delta), plus the
+                         raw per-span / per-mark op costs the tier-1
+                         self-time guard multiplies out}
 
 Usage: python tools/bench_hotpath.py [--pods 10000] [--nodes 5000]
 """
@@ -982,6 +991,154 @@ def bench_ingest(pack_pods: int = 5000):
     return out
 
 
+def bench_trace_overhead(num_pods: int = 1000, num_nodes: int = 200):
+    """BatchSpan spine + flight recorder ON vs compiled-out
+    (KTPU_FLIGHTRECORDER=0 semantics) on a real 1k-pod closed-loop
+    burst: ONE warmed scheduler stack, arms interleaved OFF/ON/OFF/ON
+    so box drift doesn't read as recorder bias. The denominator is the
+    hot-path wall-clock the ISSUE bounds -- the pop+pack+solve+
+    download+commit stage-timer delta, not the end-to-end burst (which
+    is dominated by apiserver/bind threads the recorder never touches).
+
+    Also measures the recorder's raw op costs (one full span lifecycle
+    with a 256-pod link list + 5 stage stamps, and one mark), which the
+    tier-1 guard (tests/test_flightrecorder.py) multiplies by the op
+    counts of a real burst for a deterministic <1% self-time bound.
+    """
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.client.client import Client
+    from kubernetes_tpu.client.informer import InformerFactory
+    from kubernetes_tpu.scheduler.scheduler import new_scheduler
+    from kubernetes_tpu.testing import make_node, make_pod
+    from kubernetes_tpu.utils import flightrecorder
+
+    HOT = ("pop_batch", "pack", "device_solve", "download", "commit")
+
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(client, informers, batch=True, max_batch=256)
+    for i in range(num_nodes):
+        client.create_node(
+            make_node(f"to-node-{i}")
+            .capacity(cpu="64", memory="256Gi", pods=2000)
+            .obj()
+        )
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    sched.warmup()
+    sched.start()
+
+    def one_burst(tag: str) -> float:
+        names = [f"to-{tag}-{i}" for i in range(num_pods)]
+        before = dict(sched.stage_seconds)
+        t_deadline = time.time() + 120
+        for n in names:
+            client.create_pod(
+                make_pod(n).container(cpu="10m", memory="16Mi").obj()
+            )
+        outstanding = set(names)
+        while outstanding and time.time() < t_deadline:
+            pods_now, _ = client.list_pods()
+            outstanding -= {
+                p.metadata.name for p in pods_now if p.spec.node_name
+            }
+            if outstanding:
+                time.sleep(0.02)
+        assert not outstanding, f"burst {tag} did not bind"
+        sched.wait_for_inflight_binds()
+        after = sched.stage_seconds
+        hot = sum(after.get(k, 0.0) - before.get(k, 0.0) for k in HOT)
+        # return the cluster to baseline: a burst's bound pods must not
+        # make the NEXT arm's stack heavier (the arms would otherwise
+        # read cluster fill as recorder overhead)
+        for ns, name in [("default", n) for n in names]:
+            client.delete_pod(ns, name)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            pods_now, _ = client.list_pods()
+            if not pods_now:
+                break
+            time.sleep(0.02)
+        return hot
+
+    saved = flightrecorder.ENABLED
+    on_runs, off_runs = [], []
+    spans_before = flightrecorder.RECORDER._next_id
+    try:
+        one_burst("warm")  # discarded: first burst pays residual warmup
+        spans_before = flightrecorder.RECORDER._next_id
+        for i, arm in enumerate(("off", "on") * 3):
+            flightrecorder.ENABLED = arm == "on"
+            hot = one_burst(f"{arm}{i}")
+            (on_runs if arm == "on" else off_runs).append(hot)
+    finally:
+        flightrecorder.ENABLED = saved
+        sched.stop()
+        informers.stop()
+
+    on_ms = sorted(on_runs)[len(on_runs) // 2] * 1000
+    off_ms = sorted(off_runs)[len(off_runs) // 2] * 1000
+    spans_per_burst = max(
+        1, (flightrecorder.RECORDER._next_id - spans_before) // 3
+    )
+
+    # raw op costs on a private recorder (ring appends + tuple lists);
+    # min-of-3 loops -- the right estimator for a fixed op cost under
+    # scheduler-noise interference
+    rec = flightrecorder.FlightRecorder()
+    pod_links = [(f"uid-{i}", 0.001, 1) for i in range(256)]
+    n_ops = 2000
+    span_us = min(
+        _time_span_ops(rec, pod_links, HOT, n_ops) for _ in range(3)
+    )
+    mark_us = min(_time_mark_ops(rec, n_ops * 5) for _ in range(3))
+
+    # deterministic self-time bound: the ops a 1k-pod burst actually
+    # performs, costed at the measured per-op rate. The wall-clock A/B
+    # above is reported for honesty but on a busy 2-core box its noise
+    # floor (+-20-30%) is far above a <1% effect; the self-time share
+    # is the number the tier-1 guard asserts on.
+    self_ms = (spans_per_burst * span_us + 50 * mark_us) / 1000.0
+    return {
+        "trace_on_hot_ms": round(on_ms, 1),
+        "trace_off_hot_ms": round(off_ms, 1),
+        "trace_overhead_wallclock_pct": round(
+            (on_ms - off_ms) / off_ms * 100.0, 2
+        ) if off_ms > 0 else 0.0,
+        "trace_spans_per_burst": spans_per_burst,
+        "trace_span_us": round(span_us, 2),
+        "trace_mark_us": round(mark_us, 3),
+        "trace_selftime_ms": round(self_ms, 3),
+        "trace_overhead_selftime_pct": round(
+            self_ms / off_ms * 100.0, 3
+        ) if off_ms > 0 else 0.0,
+    }
+
+
+def _time_span_ops(rec, pod_links, stages, n_ops: int) -> float:
+    """us per full span lifecycle: the 256-entry pod-link list build
+    (the per-pod tuple comprehension _dispatch_solve pays), begin (ring
+    append), 5 stage stamps, finish."""
+    uids = [u for u, _, _ in pod_links]
+    t0 = time.perf_counter()
+    for _ in range(n_ops):
+        links = [(u, 0.001, 1) for u in uids]
+        span = rec.begin_batch(256, pods=links)
+        for st in stages:
+            span.stage(st, 0.001)
+        span.finish(tier="xla")
+    return (time.perf_counter() - t0) / n_ops * 1e6
+
+
+def _time_mark_ops(rec, n_ops: int) -> float:
+    t0 = time.perf_counter()
+    for _ in range(n_ops):
+        rec.mark("fallback", tier="xla", reason="bench")
+    return (time.perf_counter() - t0) / n_ops * 1e6
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--pods", type=int, default=10000)
@@ -1043,6 +1200,7 @@ def main() -> None:
     preempt = bench_preemption_wave(args.nodes)
     fanout = bench_watch_fanout()
     ingest = bench_ingest()
+    trace_overhead = bench_trace_overhead()
 
     record = {
         "metric": "hotpath_microbench",
@@ -1094,6 +1252,7 @@ def main() -> None:
             for k, v in ingest.items()
         }
     )
+    record.update(trace_overhead)
     print(json.dumps(record))
 
 
