@@ -993,8 +993,41 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
                 )
             pods.append(p)
 
+        # -- poison seeding (blast-radius containment, ISSUE 14) -----------
+        # `poison: {count: N, seed: S}` stamps N measured pods at seeded
+        # random offsets; they must end QUARANTINED (parked, typed
+        # condition), never bound, while every healthy pod still binds
+        # -- so they are excluded from the bind targets and the workload
+        # additionally fails unless all of them parked.
+        poison_cfg = wl.get("poison")
+        poison_names: set = set()
+        if poison_cfg:
+            import random as _random
+
+            from kubernetes_tpu.robustness.faults import (
+                FaultInjector,
+                FaultProfile,
+                POISON_ANNOTATION,
+                install_injector,
+            )
+
+            prng = _random.Random(int(poison_cfg.get("seed", 0)))
+            count = min(int(poison_cfg.get("count", 1)), len(pods))
+            for i in sorted(prng.sample(range(len(pods)), count)):
+                pods[i].metadata.annotations[POISON_ANNOTATION] = "true"
+                poison_names.add(pods[i].metadata.name)
+            if injector is None:
+                # poison manifests only with an injector installed
+                injector = FaultInjector(FaultProfile(
+                    "poison-workload", seed=0, points={}
+                ))
+                install_injector(injector)
+
         churn = wl.get("churn")
-        target_names = [p.metadata.name for p in pods]
+        target_names = [
+            p.metadata.name for p in pods
+            if p.metadata.name not in poison_names
+        ]
         coll = BindCollector(server, target_names)
         create_times: Dict[str, float] = {}
 
@@ -1109,6 +1142,21 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         if _timeline.ENABLED:
             print(_timeline.dump(start), file=sys.stderr, flush=True)
         sched.wait_for_inflight_binds(timeout=60)
+
+        if poison_names:
+            # settle: every stamped pod must finish its strike budget
+            # and park (the containment acceptance half of the row)
+            q_deadline = time.time() + 120
+            while (
+                time.time() < q_deadline
+                and sched.queue.quarantine_parked_count()
+                < len(poison_names)
+            ):
+                time.sleep(0.1)
+            ok = ok and (
+                sched.queue.quarantine_parked_count()
+                == len(poison_names)
+            )
 
         if lifecycle:
             # teardown restores reclaimed capacity (driver.stop());
@@ -1258,6 +1306,24 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
             result["solver"]["tensor_full_repacks"] = tc.full_repacks
             result["solver"]["tensor_rows_added"] = tc.rows_added
             result["solver"]["tensor_rows_retired"] = tc.rows_retired
+        qm = getattr(sched, "quarantine", None)
+        if poison_names or (qm is not None and qm.isolations):
+            # blast-radius containment labels (the poison-chaos row's
+            # own numbers): bisection work done, the strike ledger, and
+            # the parked outcome the ok verdict above depends on
+            result["containment"] = {
+                "poison_pods": len(poison_names),
+                "bisections": getattr(sched, "bisections", 0),
+                "isolations": qm.isolations if qm is not None else 0,
+                "holds": qm.holds if qm is not None else 0,
+                "parks": qm.parks if qm is not None else 0,
+                "quarantine_parked": (
+                    sched.queue.quarantine_parked_count()
+                ),
+                "carry_audit_heals": getattr(
+                    sched, "carry_audit_heals", 0
+                ),
+            }
         if preempt_cfg:
             from kubernetes_tpu.utils import metrics as _metrics
 
@@ -1350,6 +1416,12 @@ def to_data_items(results: List[Dict[str, Any]]) -> Dict[str, Any]:
         labels = {"Name": r["name"]}
         labels.update(
             {f"solver_{k}": str(v) for k, v in (r.get("solver") or {}).items()}
+        )
+        labels.update(
+            {
+                f"containment_{k}": str(v)
+                for k, v in (r.get("containment") or {}).items()
+            }
         )
         labels.update(
             {

@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional
 import yaml
 
 from kubernetes_tpu.config.types import (
+    ContainmentConfiguration,
     FaultInjectionConfiguration,
     FaultPointConfiguration,
     KubeSchedulerConfiguration,
@@ -209,6 +210,16 @@ def load_config_from_dict(raw: Dict[str, Any]) -> KubeSchedulerConfiguration:
         retry_max_backoff_seconds=_duration_seconds(
             rb_raw.get("retryMaxBackoff", 1.0)
         ),
+    )
+    ct_raw = raw.get("containment", {})
+    cfg.containment = ContainmentConfiguration(
+        enabled=bool(ct_raw.get("enabled", True)),
+        max_strikes=int(ct_raw.get("maxStrikes", 3)),
+        base_hold_seconds=_duration_seconds(
+            ct_raw.get("baseHold", 0.25)
+        ),
+        max_hold_seconds=_duration_seconds(ct_raw.get("maxHold", 5.0)),
+        bisect_abort_after=int(ct_raw.get("bisectAbortAfter", 4)),
     )
     rs_raw = raw.get("resilience", {})
     cfg.resilience = ResilienceConfiguration(

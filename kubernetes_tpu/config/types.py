@@ -220,6 +220,19 @@ class RobustnessConfiguration:
 
 
 @dataclass
+class ContainmentConfiguration:
+    """Blast-radius containment knobs (robustness/containment.py):
+    poison bisection of ladder-exhausted batches + the quarantine
+    ledger's strike budget and hold schedule."""
+
+    enabled: bool = True
+    max_strikes: int = 3  # isolations before parking (PodQuarantined)
+    base_hold_seconds: float = 0.25  # first hold; doubles per strike
+    max_hold_seconds: float = 5.0
+    bisect_abort_after: int = 4  # zero-success isolations -> systemic abort
+
+
+@dataclass
 class FaultPointConfiguration:
     """One injection point's firing policy (robustness/faults.py)."""
 
@@ -260,6 +273,9 @@ class KubeSchedulerConfiguration:
     )
     robustness: RobustnessConfiguration = field(
         default_factory=RobustnessConfiguration
+    )
+    containment: ContainmentConfiguration = field(
+        default_factory=ContainmentConfiguration
     )
     resilience: ResilienceConfiguration = field(
         default_factory=ResilienceConfiguration

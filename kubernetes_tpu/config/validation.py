@@ -115,6 +115,19 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
         if rb.retry_backoff_seconds < 0:
             errors.append("robustness.retryBackoff must be >= 0")
 
+    ct = getattr(cfg, "containment", None)
+    if ct is not None:
+        if ct.max_strikes < 1:
+            errors.append("containment.maxStrikes must be >= 1")
+        if ct.base_hold_seconds < 0:
+            errors.append("containment.baseHold must be >= 0")
+        if ct.max_hold_seconds < ct.base_hold_seconds:
+            errors.append(
+                "containment.maxHold must be >= containment.baseHold"
+            )
+        if ct.bisect_abort_after < 1:
+            errors.append("containment.bisectAbortAfter must be >= 1")
+
     rs = getattr(cfg, "resilience", None)
     if rs is not None:
         if rs.sweep_interval_seconds <= 0:

@@ -164,6 +164,26 @@ class PriorityQueue:
             is PrioritySort.queue_sort_key
         )
         self.unschedulable_q: Dict[str, PodInfo] = {}
+        # blast-radius containment (robustness/containment.py): pods
+        # isolated by poison bisection. HELD pods sit out an escalating
+        # hold, released back to the activeQ by the flush loop; PARKED
+        # pods exhausted their retry budget and stay until deleted or a
+        # REAL spec update (cluster events never wake them -- that is
+        # the point: a poison pod must stop re-entering batches).
+        self._quarantine_held: Dict[str, PodInfo] = {}
+        self._quarantine_release: Dict[str, float] = {}  # key -> due
+        self._quarantine_parked: Dict[str, PodInfo] = {}
+        # once quarantine has been used, num_pending keeps emitting the
+        # quarantine keys even at zero (a scrape-driven pending_pods
+        # gauge must be refreshed DOWN, not left at its last nonzero
+        # sample); a queue that never quarantined keeps the stock
+        # three-key shape
+        self._quarantine_seen = False
+        # optional hook: called (outside the queue lock commitment --
+        # the callback must be non-blocking or thread-spawning) with
+        # the pod when a PARKED entry is released by a real spec
+        # update, so the owner can clear the PodQuarantined condition
+        self.on_quarantine_release = None
         self.nominated_pods = _NominatedPodMap()
 
         self.scheduling_cycle = 0
@@ -201,6 +221,22 @@ class PriorityQueue:
 
     def _add_locked(self, pod: Pod, now: float) -> None:
         key = _pod_key(pod)
+        held = self._quarantine_held.get(key)
+        parked = held or self._quarantine_parked.get(key)
+        if parked is not None:
+            if parked.pod.metadata.uid == pod.metadata.uid:
+                # a re-delivered add (relist echo) for a quarantined
+                # incarnation must not resurrect it into the activeQ
+                parked.pod = pod
+                return
+            # a NEW incarnation under the same key: the quarantined
+            # object is gone; the replacement starts clean
+            self._quarantine_held.pop(key, None)
+            self._quarantine_release.pop(key, None)
+            if self._quarantine_parked.pop(key, None) is not None:
+                metrics.quarantine_parked.set(
+                    len(self._quarantine_parked)
+                )
         self.active_q.add(PodInfo(pod, now))
         self.unschedulable_q.pop(key, None)
         self.pod_backoff_q.delete_by_key(key)
@@ -212,6 +248,10 @@ class PriorityQueue:
         self.active_q.delete_by_key(key)
         self.pod_backoff_q.delete_by_key(key)
         self.unschedulable_q.pop(key, None)
+        self._quarantine_held.pop(key, None)
+        self._quarantine_release.pop(key, None)
+        if self._quarantine_parked.pop(key, None) is not None:
+            metrics.quarantine_parked.set(len(self._quarantine_parked))
 
     def add(self, pod: Pod) -> None:
         """New pending pod (reference :246 Add)."""
@@ -348,6 +388,40 @@ class PriorityQueue:
                     del self.unschedulable_q[key]
                     self.active_q.add(pi)
                     self._cond.notify()
+                return
+            pi = self._quarantine_held.get(key) or (
+                self._quarantine_parked.get(key)
+            )
+            if pi is not None:
+                updated = _is_pod_updated(old_pod, new_pod)
+                pi.pod = new_pod
+                if not updated:
+                    # status-only change (incl. our own PodQuarantined
+                    # condition write): stay quarantined
+                    return
+                # a REAL spec/label change is operator intervention:
+                # release for a fresh attempt (the strike ledger in the
+                # QuarantineManager survives; a still-poisoned pod
+                # re-parks on its next isolation)
+                self._quarantine_held.pop(key, None)
+                self._quarantine_release.pop(key, None)
+                was_parked = (
+                    self._quarantine_parked.pop(key, None) is not None
+                )
+                if was_parked:
+                    metrics.quarantine_parked.set(
+                        len(self._quarantine_parked)
+                    )
+                self.active_q.add(pi)
+                self._cond.notify()
+                if was_parked and self.on_quarantine_release is not None:
+                    # the typed PodQuarantined condition must not
+                    # outlive the park (callback is thread-spawning /
+                    # non-blocking by contract)
+                    try:
+                        self.on_quarantine_release(pi.pod)
+                    except Exception:
+                        pass  # releasing must never fail on bookkeeping
                 return
             self.add(new_pod)
 
@@ -625,6 +699,86 @@ class PriorityQueue:
             events.AssignedPodUpdate,
         )
 
+    # -- quarantine (blast-radius containment, robustness/containment.py) ---
+
+    def quarantine_pod(self, pi: PodInfo, hold_seconds: float) -> None:
+        """Hold an isolated (already popped) pod OUT of every queue for
+        ``hold_seconds``; the flush loop releases it to the activeQ for
+        its next bounded retry. Cluster events never shorten the hold
+        (unlike unschedulableQ parking, where any move request wakes
+        the pod -- a poison pod must not surf wakeups back into
+        batches)."""
+        with self._cond:
+            key = _info_key(pi)
+            self._quarantine_seen = True
+            self._delete_from_queues_locked(key)
+            self._quarantine_held[key] = pi
+            self._quarantine_release[key] = self._now() + max(
+                0.0, hold_seconds
+            )
+
+    def park_quarantined(self, pi: PodInfo) -> None:
+        """Terminal quarantine: the pod stays parked until it is
+        deleted or an operator lands a real spec update (queue.update
+        releases it then). Never flushed, never woken by move
+        requests."""
+        with self._cond:
+            key = _info_key(pi)
+            self._quarantine_seen = True
+            self._delete_from_queues_locked(key)
+            self._quarantine_held.pop(key, None)
+            self._quarantine_release.pop(key, None)
+            self._quarantine_parked[key] = pi
+            # the gauge tracks THIS map at every mutation (park,
+            # delete, new-incarnation purge, spec-update release), so
+            # a dashboard alert clears when the last parked pod goes
+            metrics.quarantine_parked.set(len(self._quarantine_parked))
+
+    def _delete_from_queues_locked(self, key: str) -> None:
+        self.active_q.delete_by_key(key)
+        self.pod_backoff_q.delete_by_key(key)
+        self.unschedulable_q.pop(key, None)
+
+    def flush_quarantine_released(self) -> int:
+        """Move held pods whose hold expired back to the activeQ (run
+        alongside the backoff flush). Returns the number released."""
+        released = 0
+        with self._cond:
+            if not self._quarantine_held:
+                return 0
+            now = self._now()
+            due = [
+                key for key, t in self._quarantine_release.items()
+                if t <= now
+            ]
+            for key in due:
+                pi = self._quarantine_held.pop(key, None)
+                self._quarantine_release.pop(key, None)
+                if pi is None:
+                    continue
+                pi.timestamp = now
+                self.active_q.add(pi)
+                released += 1
+            if released:
+                metrics.quarantine_releases.inc(released)
+                self._cond.notify_all()
+        return released
+
+    def quarantine_held_count(self) -> int:
+        with self._lock:
+            return len(self._quarantine_held)
+
+    def quarantine_parked_count(self) -> int:
+        with self._lock:
+            return len(self._quarantine_parked)
+
+    def quarantined_pods(self) -> List[PodInfo]:
+        """Held + parked, held first (introspection/tests)."""
+        with self._lock:
+            return list(self._quarantine_held.values()) + list(
+                self._quarantine_parked.values()
+            )
+
     # -- flush loops (reference :234-237 run goroutines) --------------------
 
     def flush_backoff_q_completed(self) -> None:
@@ -679,6 +833,13 @@ class PriorityQueue:
             threading.Thread(
                 target=loop,
                 args=(self.flush_unschedulable_q_leftover, 30.0),
+                daemon=True,
+            ),
+            # quarantine holds are sub-second at strike 1; a 1s cadence
+            # would round every hold up to the flush tick
+            threading.Thread(
+                target=loop,
+                args=(self.flush_quarantine_released, 0.2),
                 daemon=True,
             ),
         ]
@@ -757,12 +918,22 @@ class PriorityQueue:
                 [pi.pod for pi in self.active_q.list()]
                 + [pi.pod for pi in self.pod_backoff_q.list()]
                 + [pi.pod for pi in self.unschedulable_q.values()]
+                + [pi.pod for pi in self._quarantine_held.values()]
+                + [pi.pod for pi in self._quarantine_parked.values()]
             )
 
     def num_pending(self) -> Dict[str, int]:
         with self._lock:
-            return {
+            counts = {
                 "active": len(self.active_q),
                 "backoff": len(self.pod_backoff_q),
                 "unschedulable": len(self.unschedulable_q),
             }
+            # containment states appear once quarantine has ever been
+            # used -- and then STAY, even at zero, so a scrape-driven
+            # gauge refreshes down; a queue that never quarantined
+            # keeps the stock three-queue shape
+            if self._quarantine_seen:
+                counts["quarantined"] = len(self._quarantine_held)
+                counts["quarantine_parked"] = len(self._quarantine_parked)
+            return counts

@@ -12,11 +12,20 @@ quality may degrade, the control loop never stops. This package provides
   wall-clock watchdog for device solves.
 - ``ladder``: the degradation ladder Pallas -> XLA scan -> host greedy
   -> sequential oracle, with the host-greedy numpy solver.
+- ``containment``: blast-radius containment -- poison-pod bisection
+  policy + the quarantine ledger (escalating holds, bounded strikes,
+  typed ``PodQuarantined`` parking).
 
-Integration points: scheduler/batch.py (solve path), scheduler/
-scheduler.py (bind retry), client/informer.py (relist on watch error).
+Integration points: scheduler/batch.py (solve path + bisection +
+carry audit), scheduler/scheduler.py (bind retry, sequential poison
+seam), client/informer.py (relist on watch error), scheduler/
+resilience.py (the carry-audit sweep).
 """
 
+from kubernetes_tpu.robustness.containment import (
+    ContainmentConfig,
+    QuarantineManager,
+)
 from kubernetes_tpu.robustness.circuit import (
     BreakerOpen,
     CircuitBreaker,
@@ -44,9 +53,11 @@ from kubernetes_tpu.robustness.ladder import (
 __all__ = [
     "BreakerOpen",
     "CircuitBreaker",
+    "ContainmentConfig",
     "FaultInjected",
     "FaultInjector",
     "FaultPoint",
+    "QuarantineManager",
     "RetryPolicy",
     "RobustnessConfig",
     "SolveTimeout",

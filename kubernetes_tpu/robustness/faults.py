@@ -72,12 +72,30 @@ class FaultPoint:
     #: via podEligibleToPreemptOthers' terminating-victim check instead
     #: of re-evicting the same incarnation
     VICTIM_SLOW_DEATH = "victim_slow_death"
+    #: stamps a POD (evaluated once per newly popped pod by the batch
+    #: scheduler's drain loop): every solver-ladder tier of any batch
+    #: containing the stamped pod fails, and its sequential attempt
+    #: fails alone -- the per-pod persistent failure the bisection /
+    #: quarantine containment plane exists to isolate. Tests and the
+    #: poison-chaos workload may also stamp pods directly
+    #: (``stamp_poison`` / the POISON_ANNOTATION).
+    POISON_POD = "poison_pod"
+    #: flips bytes in one device-resident carry row (evaluated per
+    #: committed batch): silent state corruption the carry integrity
+    #: audit must detect and heal before it mis-places pods
+    CARRY_CORRUPT = "carry_corrupt"
+    #: the device fails outright (evaluated per dispatch): ALL resident
+    #: state is gone; in-flight batches must recover through the
+    #: requeue machinery and the next dispatch rebuilds from the host
+    #: cache via the cold-upload path (detection -> rebuilt is metered)
+    DEVICE_LOST = "device_lost"
 
     ALL = (
         DEVICE_SOLVE, DEVICE_SOLVE_HANG, SOLVE_GARBAGE, BIND_CONFLICT,
         WATCH_DROP, LEASE_RENEW_FAIL, API_UNAVAILABLE,
         CRASH_BETWEEN_ASSUME_AND_BIND, WATCH_HISTORY_TRUNCATED,
         NODE_FLAP, RECLAIM_STORM, PREEMPT_SOLVE, VICTIM_SLOW_DEATH,
+        POISON_POD, CARRY_CORRUPT, DEVICE_LOST,
     )
 
 
@@ -88,6 +106,73 @@ class FaultInjected(Exception):
     def __init__(self, point: str) -> None:
         super().__init__(f"injected fault at {point!r}")
         self.point = point
+
+
+class PoisonError(RuntimeError):
+    """Raised by a solve/schedule seam when a stamped poison pod is in
+    the dispatch: models a spec that crashes pack, NaN-inducing
+    requests, or a row that makes the kernel emit garbage. Persistent
+    per POD (unlike FaultInjected's per-draw transience), so it keeps
+    firing until containment isolates the pod."""
+
+    def __init__(self, key: str) -> None:
+        super().__init__(f"injected poison pod {key}")
+        self.pod_key = key
+
+
+#: annotation form of the poison stamp: survives the apiserver round
+#: trip, so tests and chaos workloads can poison a pod AT CREATION
+#: (the fault-point form stamps by UID at pop time instead)
+POISON_ANNOTATION = "ktpu.dev/poison-pod"
+
+#: uid-keyed stamp + eval ledgers: the informer replaces pod OBJECTS on
+#: every status echo (queue.update sets pi.pod = new_pod), so a
+#: __dict__ memo would wash the stamp -- and the one-draw-per-pod
+#: guarantee -- away mid-chaos. Both sets are cleared by
+#: install_injector so runs stay isolated.
+_poisoned_uids: set = set()
+_poison_eval_uids: set = set()
+
+
+def stamp_poison(pod) -> None:
+    """Directly stamp a pod as poison by UID (the deterministic form
+    chaos tests use for chosen offsets; the POISON_POD fault point
+    stamps probabilistically at pop time via poison_stamp_maybe)."""
+    _poisoned_uids.add(pod.metadata.uid)
+
+
+def poison_stamp_maybe(pod) -> None:
+    """One POISON_POD draw per pod EVER (keyed by uid, so re-pops and
+    informer object replacements never re-draw); a firing draw stamps
+    the pod for the rest of the run."""
+    inj = _injector
+    if inj is None:
+        return
+    uid = pod.metadata.uid
+    if uid in _poison_eval_uids:
+        return
+    _poison_eval_uids.add(uid)
+    if inj.should_fire(FaultPoint.POISON_POD):
+        _poisoned_uids.add(uid)
+
+
+def pod_is_poisoned(pod) -> bool:
+    """True when the pod carries either poison stamp. Manifests only
+    while an injector is installed (see poison_raise_maybe) -- the
+    annotation on its own is inert in production."""
+    if pod.metadata.uid in _poisoned_uids:
+        return True
+    ann = pod.metadata.annotations
+    return bool(ann) and ann.get(POISON_ANNOTATION) == "true"
+
+
+def poison_raise_maybe(pod) -> None:
+    """Raise PoisonError when the pod is stamped and an injector is
+    installed. The solve seams call this per dispatched batch member;
+    the sequential path calls it per attempt (the reference economics:
+    a malformed pod fails ALONE there)."""
+    if _injector is not None and pod_is_poisoned(pod):
+        raise PoisonError(pod.key())
 
 
 class SchedulerCrashed(Exception):
@@ -220,9 +305,13 @@ _injector: Optional[FaultInjector] = None
 
 
 def install_injector(injector: Optional[FaultInjector]) -> None:
-    """Install (or clear, with None) the process-wide injector."""
+    """Install (or clear, with None) the process-wide injector. Also
+    resets the poison stamp/eval ledgers so consecutive chaos runs
+    (and tests) start clean."""
     global _injector
     _injector = injector
+    _poisoned_uids.clear()
+    _poison_eval_uids.clear()
 
 
 def get_injector() -> Optional[FaultInjector]:
@@ -330,6 +419,31 @@ def builtin_profiles() -> Dict[str, FaultProfile]:
                 FaultPoint.BIND_CONFLICT: PointConfig(rate=1.0, max_fires=1),
                 FaultPoint.VICTIM_SLOW_DEATH: PointConfig(
                     rate=0.5, max_fires=8, hang_seconds=0.3
+                ),
+            },
+        ),
+        # blast-radius containment chaos (ISSUE-14 acceptance shape):
+        # a few poison pods stamped into the stream (each drags every
+        # batch containing it down the full ladder until bisection
+        # isolates it into quarantine), one silent carry-row corruption
+        # (the integrity audit must detect + heal it), and one
+        # device-loss event (resident state rebuilt from the host cache
+        # through the cold-upload path, in-flight batches requeued).
+        # Healthy pods must keep binding at a DEVICE tier throughout --
+        # the containment plane exists so the blast radius is the
+        # poison pod, not the batch.
+        "poison-chaos": FaultProfile(
+            name="poison-chaos",
+            seed=0,
+            points={
+                FaultPoint.POISON_POD: PointConfig(
+                    rate=0.01, max_fires=3
+                ),
+                FaultPoint.CARRY_CORRUPT: PointConfig(
+                    rate=0.2, max_fires=1
+                ),
+                FaultPoint.DEVICE_LOST: PointConfig(
+                    rate=0.1, max_fires=1
                 ),
             },
         ),

@@ -35,6 +35,7 @@ from kubernetes_tpu.robustness.circuit import (
     SolveTimeout,
     Watchdog,
 )
+from kubernetes_tpu.robustness.faults import PoisonError
 from kubernetes_tpu.utils import flightrecorder, metrics
 
 T = TypeVar("T")
@@ -168,16 +169,25 @@ class SolverLadder:
                 continue
             except Exception as e:  # noqa: BLE001 - any failure steps down
                 last_error = e
-                if breaker is not None:
+                # a poison pod is a BATCH-CONTENT fault, not a tier
+                # fault: charging the breaker would open the tier and
+                # strip healthy batches of their device path as
+                # collateral damage -- the bisection containment owns
+                # the poison's disposition instead
+                poison = isinstance(e, PoisonError)
+                if breaker is not None and not poison:
                     breaker.record_failure()
+                reason = (
+                    f"{tier}_poison" if poison else f"{tier}_error"
+                )
                 metrics.solver_fallbacks.inc(
                     tier=self._next_tier_name(attempts, idx),
-                    reason=f"{tier}_error",
+                    reason=reason,
                 )
                 flightrecorder.mark(
                     "fallback",
                     tier=self._next_tier_name(attempts, idx),
-                    reason=f"{tier}_error",
+                    reason=reason,
                 )
                 continue
             if breaker is not None:
@@ -215,6 +225,9 @@ class SolverLadder:
             except SolveTimeout:
                 raise  # a hang is terminal for the tier (no retry:
                 # retrying would park another worker on a wedged link)
+            except PoisonError:
+                raise  # per-pod persistent: in-place retries of the
+                # same batch content cannot succeed, only burn backoff
             except Exception:
                 if attempt >= max_attempts:
                     raise

@@ -84,10 +84,17 @@ from kubernetes_tpu.ops.topology import (
     pad_spread_tensors,
 )
 from kubernetes_tpu.robustness.circuit import SolveTimeout
+from kubernetes_tpu.robustness.containment import (
+    ContainmentConfig,
+    QuarantineManager,
+)
 from kubernetes_tpu.robustness.faults import (
     FaultPoint,
+    PoisonError,
     SchedulerCrashed,
     get_injector,
+    pod_is_poisoned,
+    poison_stamp_maybe,
 )
 from kubernetes_tpu.robustness.ladder import (
     LadderExhausted,
@@ -301,6 +308,35 @@ def _delta_slot_pieces(
     ]
 
 
+def _audit_checksum_host(arr: np.ndarray) -> Tuple[int, int]:
+    """Order-independent wrapping checksum pair (plain sum + row-weighted
+    sum, both mod 2^32) of a host array. Must match
+    ``_audit_checksum_dev`` bit-for-bit: both sides compute in int32
+    with C wrap semantics, and wrapped +/* form a ring, so reduction
+    order never matters."""
+    a = np.asarray(arr)
+    if a.dtype != np.int32:
+        a = a.astype(np.int32)
+    if a.ndim == 1:
+        a = a[:, None]
+    w = (np.arange(a.shape[0], dtype=np.int32) + 1)[:, None]
+    s = int(a.sum(dtype=np.int32))
+    ws = int((a * w).sum(dtype=np.int32))
+    return s, ws
+
+
+def _audit_checksum_dev(arr):
+    """Device twin of ``_audit_checksum_host``: two O(N*R) int32
+    reductions ON the device -- the cheap per-sweep cost of the carry
+    audit; the full [N, R] download happens only on mismatch. Returns
+    device scalars (the caller converts once, batching the sync)."""
+    a = arr.astype(jnp.int32)
+    if a.ndim == 1:
+        a = a[:, None]
+    w = (jnp.arange(a.shape[0], dtype=jnp.int32) + 1)[:, None]
+    return jnp.sum(a, dtype=jnp.int32), jnp.sum(a * w, dtype=jnp.int32)
+
+
 class _DeviceNodeState:
     """Device-resident node tensors + the generation-handshake
     bookkeeping that validates their reuse.
@@ -340,6 +376,7 @@ class _DeviceNodeState:
         # (patched row-wise); req/nzr mirror the packed requested state
         # plus every mirrored (committed) batch
         self.alloc_shadow: Optional[np.ndarray] = None
+        self.valid_shadow: Optional[np.ndarray] = None
         self.req_shadow: Optional[np.ndarray] = None
         self.nzr_shadow: Optional[np.ndarray] = None
         # per-batch expected row deltas the host pack may not have shown
@@ -368,6 +405,7 @@ class BatchScheduler(Scheduler):
         solver_mode: str = "greedy",
         mesh=None,
         robustness_config: Optional[RobustnessConfig] = None,
+        containment_config: Optional[ContainmentConfig] = None,
         **kwargs,
     ) -> None:
         """``solver_mode``: "greedy" replays the sequential argmax exactly
@@ -521,6 +559,39 @@ class BatchScheduler(Scheduler):
         # the silent join(timeout=10) hang) -- surfaced via the
         # scheduler_degraded_health gauge and this flag
         self.commit_degraded = False
+        # -- blast-radius containment (robustness/containment.py) --------
+        # poison bisection + the quarantine ledger: a ladder-exhausted
+        # batch is split O(log B)-wise on the warm pad rungs instead of
+        # failing whole to the sequential floor; isolated pods take
+        # escalating holds and park with a typed PodQuarantined
+        # condition when the strike budget runs out
+        self.containment_config = containment_config or ContainmentConfig()
+        self.quarantine = QuarantineManager(
+            self.queue, self.client, self.containment_config
+        )
+        # a real spec update releasing a PARKED pod must also clear its
+        # apiserver-visible PodQuarantined condition
+        self.queue.on_quarantine_release = (
+            self.quarantine.clear_condition_async
+        )
+        self.bisections = 0
+        self.pods_quarantined = 0
+        # ladder_exhausted crash-loop detector: the uid signature of the
+        # last exhausted batch and how many consecutive times it
+        # exhausted (>= 2 books exhausted_crashloop and forces the
+        # containment path over another identical full-batch retry)
+        self._last_exhaust_sig: Optional[frozenset] = None
+        self._exhaust_repeats = 0
+        # carry integrity audit bookkeeping: the dispatch sequence lets
+        # an audit detect that a dispatch/commit raced its checksum
+        # window (bumped per dispatch AND per shadow mirror)
+        self._dispatch_seq = 0
+        self.carry_audits = 0
+        self.carry_audit_heals = 0
+        # device-loss rebuild: perf_counter at loss detection; cleared
+        # (and metered into device_rebuild_ms) when the next jitted
+        # solve lands on fully re-uploaded state
+        self._device_lost_at: Optional[float] = None
 
     # -- one batch ----------------------------------------------------------
 
@@ -606,9 +677,16 @@ class BatchScheduler(Scheduler):
         # regression). Stale volume classifications re-check inside
         # _admission_of.
         profiling = self.profile_stages
+        inj = get_injector()
         for pi in batch_infos:
             if self._skip_pod_schedule(pi.pod):
                 continue
+            if inj is not None:
+                # one POISON_POD draw per pod ever (uid-keyed, so the
+                # verdict survives informer object replacement): a
+                # firing draw stamps the pod and the fault follows it
+                # through every later batch
+                poison_stamp_maybe(pi.pod)
             if profiling:
                 t_cls = time.perf_counter()
                 adm = self._admission_of(pi.pod)
@@ -1433,6 +1511,8 @@ class BatchScheduler(Scheduler):
                     ds.pending_deltas.popleft()
                 if alloc_rows.size:
                     ds.alloc_shadow[alloc_rows] = nt.allocatable[alloc_rows]
+                    if ds.valid_shadow is not None:
+                        ds.valid_shadow[alloc_rows] = nt.valid[alloc_rows]
                 if fix_rows.size:
                     ds.req_shadow[fix_rows] = node_requested[fix_rows]
                     ds.nzr_shadow[fix_rows] = node_nzr[fix_rows]
@@ -1462,6 +1542,7 @@ class BatchScheduler(Scheduler):
                     d.layout_epoch if d is not None else -1
                 )
                 ds.alloc_shadow = nt.allocatable.copy()
+                ds.valid_shadow = np.array(nt.valid, dtype=bool)
             ds.req_shadow = node_requested.copy()
             ds.nzr_shadow = node_nzr.copy()
             ds.pending_deltas.clear()
@@ -1480,13 +1561,30 @@ class BatchScheduler(Scheduler):
         solver_infos: List[PodInfo],
         pod_scheduling_cycle: int,
         inactive_uids=None,
+        raise_on_exhaust: bool = False,
     ):
         """Pack + upload + dispatch one solver batch. Returns a pending
         record for _complete_solve, or None when the batch was routed to
         the sequential path. Paths that read host-side cluster state the
         in-flight batch would change (spread counts, nominee overlays,
-        incompatible clusters) drain the pipeline first."""
+        incompatible clusters) drain the pipeline first.
+
+        ``raise_on_exhaust`` (the bisection sub-solve mode): a ladder
+        exhaustion re-raises to the caller -- after the carry-state
+        un-booking -- instead of routing the batch to containment or
+        the sequential floor (the bisection loop owns that batch's
+        disposition)."""
         timeline.mark(f"dispatch_start b={len(solver_infos)}")
+        if not raise_on_exhaust:
+            inj0 = get_injector()
+            if inj0 is not None and inj0.should_fire(
+                FaultPoint.DEVICE_LOST
+            ):
+                self._on_device_lost()
+        with self._shadow_lock:
+            # under the lock: the committer bumps this too, and a lost
+            # increment would blind the carry audit's race detector
+            self._dispatch_seq += 1
         t_pack = time.perf_counter()
         # -- flight-recorder span: one per dispatch (a gang re-solve or
         # drain-redispatch is honestly its own span), with the per-pod
@@ -1513,9 +1611,22 @@ class BatchScheduler(Scheduler):
                 span.stage("pop_batch", work, t0=t_pop0 + pop_waited)
             if inactive_uids:
                 span.note(gang_redispatch=True)
+            if raise_on_exhaust:
+                span.note(bisect=True)
         else:
             span = flightrecorder.NULL_SPAN
         pods = [pi.pod for pi in solver_infos]
+        # poison manifestation: any stamped pod in the dispatch fails
+        # every ladder tier (PoisonError), driving the exhaustion the
+        # bisection containment hangs off; a sub-batch WITHOUT the
+        # stamped pod solves normally -- exactly the signature the
+        # O(log B) search isolates on
+        poison_key = None
+        if get_injector() is not None:
+            for pod_p in pods:
+                if pod_is_poisoned(pod_p):
+                    poison_key = pod_p.key()
+                    break
         # batch-level constraint aggregates from the cached admission
         # feature bits (scheduler/admission.py): any() over memo reads
         # instead of re-walking every spec per dispatch
@@ -2012,6 +2123,8 @@ class BatchScheduler(Scheduler):
             solve_mode = "constrained" if constrained else self.solver_mode
 
             def run_device(allow_pallas: bool):
+                if poison_key is not None:
+                    raise PoisonError(poison_key)
                 inj = get_injector()
                 if inj is not None:
                     hang = inj.hang_seconds_maybe(
@@ -2033,6 +2146,11 @@ class BatchScheduler(Scheduler):
                 )
 
             def run_host_greedy():
+                if poison_key is not None:
+                    # the malformed row poisons the host replay too (it
+                    # packs from the same arrays); only the per-pod
+                    # sequential oracle fails it ALONE
+                    raise PoisonError(poison_key)
                 a, r_out, z_out = host_greedy_assign(
                     nt.allocatable, node_requested, node_nzr, nt.valid,
                     req, nzr, rows, midx, active,
@@ -2081,7 +2199,7 @@ class BatchScheduler(Scheduler):
                         if span else None,
                     )
                 self._jit_watch.refresh()
-            except LadderExhausted:
+            except LadderExhausted as exhaust_err:
                 with self._shadow_lock:
                     ds.invalidate_carry()
                     # no jitted solve LANDED, so the booked upload /
@@ -2107,6 +2225,11 @@ class BatchScheduler(Scheduler):
                         # of trusting it
                         ds.alloc_dev = None
                         ds.valid_dev = None
+                if raise_on_exhaust:
+                    # bisection sub-solve: the caller owns this group's
+                    # disposition (split further or isolate)
+                    span.finish(routed="bisect_exhausted")
+                    raise
                 if self._pending_exists():
                     # in-flight batches blocked the host tier: land them
                     # (the committer's own recovery handles their
@@ -2119,25 +2242,13 @@ class BatchScheduler(Scheduler):
                         solver_infos, pod_scheduling_cycle,
                         inactive_uids=inactive_uids,
                     )
-                metrics.solver_fallbacks.inc(
-                    tier=TIER_SEQUENTIAL, reason="ladder_exhausted"
+                return self._contain_exhausted_batch(
+                    solver_infos, pod_scheduling_cycle, span,
+                    inactive_uids,
+                    poisoned=isinstance(
+                        exhaust_err.__cause__, PoisonError
+                    ),
                 )
-                flightrecorder.mark(
-                    "fallback", tier=TIER_SEQUENTIAL,
-                    reason="ladder_exhausted",
-                )
-                span.finish(
-                    tier=TIER_SEQUENTIAL, routed="ladder_exhausted"
-                )
-                self.ladder.record_sequential(len(solver_infos))
-                logger.warning(
-                    "solver ladder exhausted; %d pods take the "
-                    "sequential oracle path", len(solver_infos),
-                )
-                for pi in solver_infos:
-                    self.pods_fallback += 1
-                    self.attempt_schedule(pi)
-                return None
             assignments_dev, req_out, nzr_out, alloc_out, valid_out = out
             if tier == TIER_HOST_GREEDY:
                 # the host tier solved from host state and no jitted
@@ -2190,6 +2301,8 @@ class BatchScheduler(Scheduler):
                         )
                 else:
                     metrics.state_uploads.inc()
+                    if self._device_lost_at is not None:
+                        self._note_device_rebuilt()
                 if not static_ok:
                     ds.alloc_dev, ds.valid_dev = alloc_out, valid_out
                 elif neg["sidx"].size:
@@ -2273,6 +2386,8 @@ class BatchScheduler(Scheduler):
             req_d, nzr_d, rows_d, midx_d, active_d,
         )
         try:
+            if poison_key is not None:
+                raise PoisonError(poison_key)
             inj = get_injector()
             if inj is not None:
                 inj.raise_maybe(FaultPoint.DEVICE_SOLVE)
@@ -2284,8 +2399,24 @@ class BatchScheduler(Scheduler):
             self._stage_add("device_solve", dt_solve)
             span.stage("device_solve", dt_solve, t0=t_solve)
             self._jit_watch.refresh()
-        except Exception:
-            # mesh path: no pallas/host tier distinction -- a failed
+        except Exception as mesh_err:
+            with self._shadow_lock:
+                ds.invalidate_carry()
+            if raise_on_exhaust:
+                # bisection sub-solve on the legacy mesh path: the
+                # caller owns the group's disposition
+                span.finish(routed="bisect_exhausted")
+                raise
+            self._drain_pending()
+            if isinstance(mesh_err, PoisonError):
+                # the legacy mesh path has no ladder, but a typed
+                # poison must still reach containment instead of
+                # storming the sequential floor on every retry
+                return self._contain_exhausted_batch(
+                    solver_infos, pod_scheduling_cycle, span,
+                    inactive_uids, poisoned=True,
+                )
+            # otherwise: no pallas/host tier distinction -- a failed
             # sharded solve steps straight down to the sequential oracle
             logger.exception("mesh solve failed; sequential fallback")
             metrics.solver_fallbacks.inc(
@@ -2296,9 +2427,6 @@ class BatchScheduler(Scheduler):
                 reason="mesh_solve_error",
             )
             span.finish(tier=TIER_SEQUENTIAL, routed="mesh_solve_error")
-            with self._shadow_lock:
-                ds.invalidate_carry()
-            self._drain_pending()
             self.ladder.record_sequential(len(solver_infos))
             for pi in solver_infos:
                 self.pods_fallback += 1
@@ -2306,6 +2434,8 @@ class BatchScheduler(Scheduler):
             return None
         if not carry_ok:
             metrics.state_uploads.inc()
+            if self._device_lost_at is not None:
+                self._note_device_rebuilt()
         # start the result transfer now so it overlaps host commit work
         try:
             assignments_dev.copy_to_host_async()
@@ -2345,6 +2475,444 @@ class BatchScheduler(Scheduler):
             "mask_rows": mask_rows,
             "mask_index_solved": midx,
         }
+
+    # -- blast-radius containment (robustness/containment.py) ----------------
+
+    def _contain_exhausted_batch(
+        self, solver_infos: List[PodInfo], pod_scheduling_cycle: int,
+        span, inactive_uids, poisoned: bool = False,
+    ):
+        """Disposition of a ladder-exhausted batch with nothing in
+        flight. Tracks the crash-loop signature (an identical batch
+        exhausting twice in a row is a retry storm, not a transient),
+        then: multi-pod batches take the bisection search, a
+        crash-looping singleton goes straight to quarantine, and
+        everything else (containment off, gang batches, first-time
+        singletons) keeps the sequential-floor fallback."""
+        sig = frozenset(
+            pi.pod.metadata.uid for pi in solver_infos
+        )
+        if sig and sig == self._last_exhaust_sig:
+            self._exhaust_repeats += 1
+        else:
+            self._last_exhaust_sig = sig
+            self._exhaust_repeats = 1
+        crashloop = self._exhaust_repeats >= 2
+        if crashloop:
+            metrics.exhausted_crashloops.inc()
+            flightrecorder.mark(
+                "exhausted_crashloop", pods=len(solver_infos),
+                repeats=self._exhaust_repeats,
+            )
+            logger.warning(
+                "ladder_exhausted crash loop: the same %d-pod batch "
+                "exhausted %d times in a row; engaging containment",
+                len(solver_infos), self._exhaust_repeats,
+            )
+        cc = self.containment_config
+        gang = any(
+            pi.pod.metadata.labels.get(POD_GROUP_LABEL)
+            for pi in solver_infos
+        )
+        if not cc.enabled or inactive_uids or gang:
+            # gang batches never bisect (a split would break the
+            # all-or-nothing quorum semantics); the sequential path
+            # keeps full correctness for them
+            return self._exhausted_sequential(
+                solver_infos, pod_scheduling_cycle, span
+            )
+        if len(solver_infos) == 1:
+            if crashloop or poisoned:
+                # the singleton itself is the poison (typed cause, or
+                # the same batch exhausting repeatedly): no batch left
+                # to protect, but redispatching it forever is the
+                # retry storm -- strike it into quarantine
+                span.finish(routed="quarantine")
+                self._quarantine_isolated(
+                    solver_infos[0],
+                    reason="poison" if poisoned else "crashloop",
+                )
+                return None
+            # first exhaustion of a singleton may be transient (breaker
+            # cool-offs, a blocked host tier): one sequential attempt
+            return self._exhausted_sequential(
+                solver_infos, pod_scheduling_cycle, span
+            )
+        span.finish(routed="bisect")
+        self._bisect_batch(
+            solver_infos, pod_scheduling_cycle, force=crashloop
+        )
+        return None
+
+    def _exhausted_sequential(
+        self, solver_infos: List[PodInfo], pod_scheduling_cycle: int,
+        span,
+    ):
+        """The pre-containment floor: the whole batch runs the per-pod
+        sequential oracle."""
+        metrics.solver_fallbacks.inc(
+            tier=TIER_SEQUENTIAL, reason="ladder_exhausted"
+        )
+        flightrecorder.mark(
+            "fallback", tier=TIER_SEQUENTIAL,
+            reason="ladder_exhausted",
+        )
+        span.finish(
+            tier=TIER_SEQUENTIAL, routed="ladder_exhausted"
+        )
+        self.ladder.record_sequential(len(solver_infos))
+        logger.warning(
+            "solver ladder exhausted; %d pods take the "
+            "sequential oracle path", len(solver_infos),
+        )
+        for pi in solver_infos:
+            self.pods_fallback += 1
+            self.attempt_schedule(pi)
+        return None
+
+    def _bisect_batch(
+        self, solver_infos: List[PodInfo], pod_scheduling_cycle: int,
+        force: bool = False,
+    ) -> None:
+        """O(log B) poison isolation: split the exhausted batch and
+        re-solve each half synchronously on the already-warm pad rungs
+        (sub-batches pad to the smallest warmed rung that fits, so no
+        sub-solve compiles). Halves that solve COMMIT at their normal
+        device tier -- the healthy pods' blast radius ends here; halves
+        that exhaust again split further until the offenders are
+        singletons, which go to the quarantine ledger.
+
+        Systemic-failure guard: ``bisect_abort_after`` isolated
+        singletons with ZERO successful sub-solves means every subset
+        fails -- a sick device, not a poison signature -- and the run
+        aborts to the sequential floor. ``force`` (set by the
+        crash-loop detector) disables the guard: a batch that already
+        exhausted repeatedly must not keep redispatching."""
+        cc = self.containment_config
+        t0 = time.perf_counter()
+        self.bisections += 1
+        metrics.bisections.inc()
+        flightrecorder.mark(
+            "bisect_start", pods=len(solver_infos), force=force
+        )
+        mid = len(solver_infos) // 2
+        work: "collections.deque" = collections.deque(
+            [solver_infos[:mid], solver_infos[mid:]]
+        )
+        # (pod_info, typed_poison) -- a singleton isolated by a TYPED
+        # PoisonError always quarantines; untyped isolations are only
+        # trusted once some sibling sub-solve succeeded (else they are
+        # indistinguishable from a systemic device failure)
+        isolated: List[Tuple[PodInfo, bool]] = []
+        done_uids: set = set()
+        successes = 0
+        subsolves = 0
+        aborted = False
+
+        def untyped_isolated() -> int:
+            return sum(1 for _pi, typed in isolated if not typed)
+
+        while work:
+            if (
+                not force
+                and successes == 0
+                and untyped_isolated() >= cc.bisect_abort_after
+            ):
+                aborted = True
+                break
+            group = list(work.popleft())
+            subsolves += 1
+            metrics.bisect_subsolves.inc()
+            try:
+                pending = self._dispatch_solve(
+                    group, pod_scheduling_cycle, raise_on_exhaust=True
+                )
+            except SchedulerCrashed:
+                raise
+            except Exception as sub_err:  # noqa: BLE001 - split again
+                if len(group) == 1:
+                    # LadderExhausted-from-PoisonError (ladder paths)
+                    # or a bare PoisonError (legacy mesh path)
+                    typed = isinstance(sub_err, PoisonError) or (
+                        isinstance(sub_err, LadderExhausted)
+                        and isinstance(sub_err.__cause__, PoisonError)
+                    )
+                    isolated.append((group[0], typed))
+                    flightrecorder.mark(
+                        "bisect_isolated",
+                        pod=group[0].pod.metadata.uid,
+                        typed=typed,
+                    )
+                else:
+                    m = len(group) // 2
+                    # left-first DFS: committed groups land in the
+                    # original pod order, so healthy placements match
+                    # the no-poison batch bit-for-bit
+                    work.appendleft(group[m:])
+                    work.appendleft(group[:m])
+                continue
+            if pending is None:
+                # the dispatch itself routed the group (envelope bails,
+                # nested containment): those paths already disposed of
+                # every pod
+                successes += 1
+                done_uids.update(
+                    pi.pod.metadata.uid for pi in group
+                )
+                continue
+            try:
+                self._complete_solve(pending)
+            except SchedulerCrashed:
+                raise
+            except Exception:  # noqa: BLE001 - download/commit failure
+                # not an exhaustion: the standard recovery requeues the
+                # group (a genuinely poisoned member re-trips
+                # containment on its next pass)
+                logger.exception("bisect sub-solve completion failed")
+                self._recover_failed_batch(pending)
+                done_uids.update(
+                    pi.pod.metadata.uid for pi in group
+                )
+                continue
+            successes += 1
+            done_uids.update(pi.pod.metadata.uid for pi in group)
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        # post-loop systemic check too: a batch SMALLER than the abort
+        # threshold can drain the work deque with zero successes and
+        # only untyped isolations -- that is still "every subset
+        # failed", not a poison signature
+        if (
+            not aborted
+            and not force
+            and successes == 0
+            and untyped_isolated() > 0
+        ):
+            aborted = True
+        if aborted:
+            metrics.bisect_aborts.inc()
+            # typed-poison singletons quarantine even on an aborted
+            # run (the cause is attributable); everything else --
+            # untyped isolations and unprocessed work -- takes the
+            # sequential floor
+            typed_pis = [pi for pi, typed in isolated if typed]
+            for pi in typed_pis:
+                done_uids.add(pi.pod.metadata.uid)
+            remaining = [
+                pi for pi in solver_infos
+                if pi.pod.metadata.uid not in done_uids
+            ]
+            flightrecorder.mark(
+                "bisect_abort", pods=len(solver_infos),
+                isolated=len(isolated), subsolves=subsolves,
+                remaining=len(remaining), ms=round(dt_ms, 3),
+            )
+            logger.warning(
+                "bisection aborted after %d failed sub-solves with no "
+                "success (systemic failure); %d pods take the "
+                "sequential path", subsolves, len(remaining),
+            )
+            for pi in typed_pis:
+                self._quarantine_isolated(pi, reason="poison")
+            self.ladder.record_sequential(len(remaining))
+            for pi in remaining:
+                self.pods_fallback += 1
+                self.attempt_schedule(pi)
+            return
+        flightrecorder.mark(
+            "bisect_done", pods=len(solver_infos),
+            isolated=len(isolated), subsolves=subsolves,
+            ms=round(dt_ms, 3),
+        )
+        for pi, typed in isolated:
+            self._quarantine_isolated(
+                pi, reason="poison" if typed else "bisect"
+            )
+
+    def _quarantine_isolated(self, pi: PodInfo, reason: str) -> None:
+        """Route one isolated pod through the quarantine ledger and
+        surface the event on the pod (Warning event; the PARK
+        additionally writes the typed PodQuarantined condition)."""
+        self.pods_quarantined += 1
+        disposition = self.quarantine.isolate(pi, reason=reason)
+        prof = self.profiles.get(pi.pod.spec.scheduler_name)
+        if prof is not None:
+            try:
+                prof.recorder.eventf(
+                    pi.pod, "Warning", "Quarantined",
+                    f"pod isolated by blast-radius containment "
+                    f"({reason}); disposition: {disposition}",
+                )
+            except Exception:  # noqa: BLE001 - events are best-effort
+                logger.exception(
+                    "quarantine event for %s", pi.pod.key()
+                )
+
+    # -- carry integrity audit + device-loss rebuild -------------------------
+
+    def audit_carry(self) -> str:
+        """One carry-integrity sweep: checksum the device-resident
+        req/nzr (and alloc/valid when resident) against the host shadow
+        with two cheap on-device int32 reductions per array; the full
+        [N, R] download happens only on mismatch. Corruption heals
+        through the counted-upload path (carry drop -> next dispatch
+        re-uploads), never silently. Runs from the
+        ControlPlaneReconciler sweep; safe to call from any thread.
+
+        Returns the disposition: "idle" (nothing resident), "busy"
+        (batches in flight -- the carry is legitimately ahead of the
+        shadow), "raced" (a dispatch/commit moved the state mid-sweep),
+        "clean", or "mismatch" (healed)."""
+        ds = self._dev
+        with self._shadow_lock:
+            if ds.req_dev is None or ds.req_shadow is None:
+                metrics.carry_audit_sweeps.inc(disposition="idle")
+                return "idle"
+            if self._pending_exists():
+                metrics.carry_audit_sweeps.inc(disposition="busy")
+                return "busy"
+            seq = self._dispatch_seq
+            req_dev, nzr_dev = ds.req_dev, ds.nzr_dev
+            alloc_dev, valid_dev = ds.alloc_dev, ds.valid_dev
+            # host checksums under the lock: the shadows mutate in
+            # place at commit time
+            host = {
+                "req": _audit_checksum_host(ds.req_shadow),
+                "nzr": _audit_checksum_host(ds.nzr_shadow),
+            }
+            if alloc_dev is not None and ds.alloc_shadow is not None:
+                host["alloc"] = _audit_checksum_host(ds.alloc_shadow)
+            if valid_dev is not None and ds.valid_shadow is not None:
+                host["valid"] = _audit_checksum_host(ds.valid_shadow)
+        self.carry_audits += 1
+        # device reductions OUTSIDE the lock (the refs are immutable
+        # arrays; a racing dispatch reassigns, never mutates)
+        dev_handles = {"req": _audit_checksum_dev(req_dev),
+                       "nzr": _audit_checksum_dev(nzr_dev)}
+        if "alloc" in host:
+            dev_handles["alloc"] = _audit_checksum_dev(alloc_dev)
+        if "valid" in host:
+            dev_handles["valid"] = _audit_checksum_dev(valid_dev)
+        dev = {
+            name: (int(np.asarray(s)), int(np.asarray(ws)))
+            for name, (s, ws) in dev_handles.items()
+        }
+        with self._shadow_lock:
+            if (
+                self._dispatch_seq != seq
+                or self._pending_exists()
+                or ds.req_dev is not req_dev
+            ):
+                metrics.carry_audit_sweeps.inc(disposition="raced")
+                return "raced"
+            mismatched = [n for n in dev if dev[n] != host[n]]
+            if not mismatched:
+                metrics.carry_audit_sweeps.inc(disposition="clean")
+                return "clean"
+            # full compare only on mismatch: name the divergent rows
+            # for the flight record, then heal
+            rows: List[int] = []
+            try:
+                if "req" in mismatched:
+                    diff = ~np.all(
+                        np.asarray(req_dev) == ds.req_shadow, axis=1
+                    )
+                    rows = np.flatnonzero(diff)[:16].tolist()
+                elif "nzr" in mismatched:
+                    diff = ~np.all(
+                        np.asarray(nzr_dev) == ds.nzr_shadow, axis=1
+                    )
+                    rows = np.flatnonzero(diff)[:16].tolist()
+            except Exception:  # noqa: BLE001 - row detail is best-effort
+                logger.exception("carry audit row compare failed")
+            for name in mismatched:
+                metrics.carry_audit_mismatches.inc(array=name)
+            flightrecorder.mark(
+                "carry_audit", arrays=",".join(sorted(mismatched)),
+                rows=rows,
+            )
+            if "req" in mismatched or "nzr" in mismatched:
+                ds.invalidate_carry()
+            if "alloc" in mismatched or "valid" in mismatched:
+                ds.alloc_dev = None
+                ds.valid_dev = None
+            metrics.carry_audit_heals.inc()
+            self.carry_audit_heals += 1
+        metrics.carry_audit_sweeps.inc(disposition="mismatch")
+        logger.warning(
+            "carry integrity audit: device-resident %s diverged from "
+            "the host shadow (rows %s); healed via the counted-upload "
+            "path", ",".join(sorted(mismatched)), rows,
+        )
+        return "mismatch"
+
+    def _corrupt_carry_row(self) -> None:
+        """CARRY_CORRUPT fired: flip bits in one device-resident carry
+        row WITHOUT touching the host shadow -- silent corruption only
+        the integrity audit can see (the generation handshake compares
+        host state against the shadow, never the device)."""
+        inj = get_injector()
+        with self._shadow_lock:
+            ds = self._dev
+            if ds.req_dev is None:
+                return
+            n = int(ds.req_dev.shape[0])
+            if n == 0:
+                return
+            fired = (
+                inj.fired_count(FaultPoint.CARRY_CORRUPT)
+                if inj is not None else 1
+            )
+            row = (fired * 131) % n
+            ds.req_dev = ds.req_dev.at[row, 0].add(1 << 20)
+        flightrecorder.mark("carry_corrupt", row=row)
+        logger.warning(
+            "injected carry corruption on resident row %d", row
+        )
+
+    def _on_device_lost(self) -> None:
+        """DEVICE_LOST fired: every device-resident buffer is gone.
+        Drop all resident state + shadows, flag the in-flight batches
+        (their results are garbage; the committer's recovery requeues
+        their pods through the PR-1 machinery), drain, and let the
+        current dispatch rebuild from the host cache through the
+        existing cold-upload path. Detection -> rebuilt is metered into
+        ``scheduler_tpu_device_rebuild_ms``."""
+        self._device_lost_at = time.perf_counter()
+        metrics.device_lost_events.inc()
+        metrics.degraded_health.set(1, reason="device_lost")
+        flightrecorder.mark("device_lost")
+        logger.error(
+            "device lost: dropping resident state, requeueing "
+            "in-flight batches, rebuilding from the host cache"
+        )
+        with self._pending_cv:
+            for p in self._pending_q:
+                p["device_lost"] = True
+        with self._shadow_lock:
+            ds = self._dev
+            ds.alloc_dev = None
+            ds.valid_dev = None
+            ds.alloc_shadow = None
+            ds.valid_shadow = None
+            ds.layout_epoch = -1
+            ds.invalidate_carry()
+        self._drain_pending()
+
+    def _note_device_rebuilt(self) -> None:
+        """The first full upload after a device loss landed under a
+        jitted solve: the resident state is rebuilt."""
+        at = self._device_lost_at
+        if at is None:
+            return
+        self._device_lost_at = None
+        dt_ms = (time.perf_counter() - at) * 1000.0
+        metrics.device_rebuild_ms.observe(dt_ms)
+        metrics.degraded_health.set(0, reason="device_lost")
+        flightrecorder.mark("device_rebuilt", ms=round(dt_ms, 3))
+        logger.warning(
+            "device state rebuilt from host cache %.1fms after loss",
+            dt_ms,
+        )
 
     @staticmethod
     def _eager_download(assignments_dev):
@@ -2412,6 +2980,16 @@ class BatchScheduler(Scheduler):
         (NaN-score argmax artifacts) must degrade, not bind pods to
         phantom nodes. Failures raise; the callers route the batch
         through _recover_failed_batch (requeue, never strand)."""
+        if p.get("device_lost"):
+            # the device died with this batch in flight: its result
+            # buffers are gone/garbage. Raise so the caller's recovery
+            # requeues every pod (the PR-1 machinery); the carry was
+            # already dropped by _on_device_lost.
+            sp = p.get("span") or flightrecorder.NULL_SPAN
+            sp.finish(routed="device_lost")
+            raise RuntimeError(
+                "device lost with this batch in flight; requeueing"
+            )
         tier = p.get("tier", TIER_XLA)
         breaker = self.ladder.breakers.get(tier)
         timeout = (
@@ -2483,6 +3061,10 @@ class BatchScheduler(Scheduler):
         metrics.batch_size.observe(b)
         ds = self._dev
         with self._shadow_lock:
+            # the audit race-detector: a commit moving the shadow (or
+            # landing a batch) invalidates any checksum window spanning
+            # this moment
+            self._dispatch_seq += 1
             if not p["overlaid"] and ds.req_shadow is not None:
                 # mirror the batch's own placements into the running
                 # expectation (same int32 arithmetic as the scan carry)
@@ -2500,6 +3082,8 @@ class BatchScheduler(Scheduler):
                     ds.pending_deltas.append(
                         (rows_placed, req_rows, nzr_rows)
                     )
+        if inj is not None and inj.should_fire(FaultPoint.CARRY_CORRUPT):
+            self._corrupt_carry_row()
         t_commit = time.perf_counter()
         with timeline.span("commit_batch"):
             self._commit_batch(

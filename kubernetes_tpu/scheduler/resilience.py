@@ -161,15 +161,20 @@ class ControlPlaneReconciler:
         client: "Client",
         sweep_interval: float = 1.0,
         drift_interval: float = 5.0,
+        carry_audit_interval: float = 2.0,
     ) -> None:
         self.sched = sched
         self.client = client
         self.sweep_interval = max(0.01, sweep_interval)
         self.drift_interval = max(self.sweep_interval, drift_interval)
+        self.carry_audit_interval = max(
+            self.sweep_interval, carry_audit_interval
+        )
         self._stop = threading.Event()
         self._thread = None
         self.sweeps = 0
         self.drift_checks = 0
+        self.carry_audits = 0
 
     # -- assumed-pod TTL expiry (the formerly dead cache path) --------------
 
@@ -345,10 +350,25 @@ class ControlPlaneReconciler:
             )
         return report
 
+    # -- carry integrity audit (blast-radius containment, ISSUE 14) ---------
+
+    def audit_carry_once(self) -> str:
+        """Run the batch scheduler's device-carry integrity audit
+        (BatchScheduler.audit_carry): cheap on-device checksums of the
+        resident req/nzr/alloc/valid state against the host shadow,
+        full compare + counted-upload heal only on mismatch. A plain
+        (non-batch) scheduler has no carry; returns "unsupported"
+        then."""
+        audit = getattr(self.sched, "audit_carry", None)
+        if audit is None:
+            return "unsupported"
+        return audit()
+
     # -- the loop ------------------------------------------------------------
 
     def _run(self) -> None:
         next_drift = self.drift_interval
+        next_audit = self.carry_audit_interval
         elapsed = 0.0
         while not self._stop.wait(self.sweep_interval):
             elapsed += self.sweep_interval
@@ -357,6 +377,13 @@ class ControlPlaneReconciler:
                 self.sweeps += 1
             except Exception:
                 logger.exception("assumed-pod sweep failed")
+            if elapsed >= next_audit:
+                next_audit = elapsed + self.carry_audit_interval
+                try:
+                    if self.audit_carry_once() != "unsupported":
+                        self.carry_audits += 1
+                except Exception:
+                    logger.exception("carry integrity audit failed")
             if elapsed >= next_drift:
                 next_drift = elapsed + self.drift_interval
                 try:

@@ -37,6 +37,7 @@ from kubernetes_tpu.robustness.faults import (
     FaultPoint,
     SchedulerCrashed,
     get_injector,
+    poison_raise_maybe,
 )
 from kubernetes_tpu.scheduler.generic import GenericScheduler
 from kubernetes_tpu.scheduler.provider import default_plugins
@@ -340,6 +341,12 @@ class Scheduler:
         state.write("__cycle_start__", time.perf_counter())
         timer = metrics.SinceTimer(metrics.scheduling_algorithm_duration)
         try:
+            # poison-pod seam (robustness/faults.py): the sequential
+            # path reproduces the reference's failure economics -- a
+            # malformed pod fails ALONE here (SchedulerError -> requeue
+            # with backoff), while batched dispatch needs the bisection
+            # containment to get the same per-pod blast radius
+            poison_raise_maybe(pod)
             result = self.algorithm.schedule(prof, state, pod)
         except FitError as fit_err:
             metrics.schedule_attempts.inc(result="unschedulable")
@@ -618,6 +625,7 @@ def new_scheduler(
     mesh=None,
     extenders: Optional[List] = None,
     robustness_config=None,
+    containment_config=None,
 ) -> Scheduler:
     """Build a fully wired scheduler (reference scheduler.go:223 New +
     factory.go create). ``batch=True`` selects the TPU batch-solver loop
@@ -701,6 +709,7 @@ def new_scheduler(
             solver_mode=solver_mode,
             mesh=mesh,
             robustness_config=robustness_config,
+            containment_config=containment_config,
         )
     else:
         sched = Scheduler(
@@ -776,6 +785,7 @@ def new_scheduler_from_config(
         mesh = Mesh(
             np.array(devices[: ts.mesh_devices]), axis_names=("nodes",)
         )
+    from kubernetes_tpu.robustness.containment import ContainmentConfig
     from kubernetes_tpu.robustness.faults import (
         injector_from_configuration,
         install_injector,
@@ -796,6 +806,9 @@ def new_scheduler_from_config(
         extenders=list(getattr(cfg, "extenders", [])),
         robustness_config=RobustnessConfig.from_configuration(
             cfg.robustness
+        ),
+        containment_config=ContainmentConfig.from_configuration(
+            cfg.containment
         ),
     )
     if ts.enabled:

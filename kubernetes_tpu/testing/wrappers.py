@@ -66,6 +66,10 @@ class PodWrapper:
         self.pod.metadata.labels.update(labels)
         return self
 
+    def annotation(self, key: str, value: str) -> "PodWrapper":
+        self.pod.metadata.annotations[key] = value
+        return self
+
     def creation_timestamp(self, ts: float) -> "PodWrapper":
         self.pod.metadata.creation_timestamp = ts
         return self

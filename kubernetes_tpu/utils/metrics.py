@@ -572,6 +572,81 @@ jit_compiles = registry.register(Counter(
     "window is exactly what the warmup contract exists to prevent.",
     ("signature",),
 ))
+# blast-radius containment (ISSUE 14): poison-pod bisection, the
+# quarantine ledger, the carry integrity audit, and device-loss rebuild
+# -- per-pod containment must be as observable as the tier fallback it
+# replaces (a quarantined pod is VISIBLE, never silently dropped)
+bisections = registry.register(Counter(
+    "scheduler_tpu_bisections_total",
+    "Ladder-exhausted batches taken down the poison-bisection path "
+    "instead of failing whole to the sequential floor.",
+))
+bisect_subsolves = registry.register(Counter(
+    "scheduler_tpu_bisect_subsolves_total",
+    "Sub-batch solves dispatched by the bisection search (O(log B) per "
+    "isolated pod; each reuses an already-warm pad rung).",
+))
+bisect_aborts = registry.register(Counter(
+    "scheduler_tpu_bisect_aborts_total",
+    "Bisection runs aborted to the sequential path because EVERY "
+    "sub-solve failed (systemic device failure, not a poison "
+    "signature).",
+))
+exhausted_crashloops = registry.register(Counter(
+    "scheduler_ladder_exhausted_crashloops_total",
+    "Identical batches that exhausted the solver ladder twice in a "
+    "row: the retry is a crash loop, so containment (bisection / "
+    "quarantine) takes over instead of a third full-batch retry.",
+))
+quarantine_pods = registry.register(Counter(
+    "scheduler_quarantine_pods_total",
+    "Pod isolation events booked by the quarantine ledger, by "
+    "disposition (held = escalating out-of-queue backoff; parked = "
+    "retry budget exhausted, PodQuarantined condition written) and "
+    "isolation reason.",
+    ("disposition", "reason"),
+))
+quarantine_parked = registry.register(Gauge(
+    "scheduler_quarantine_parked",
+    "Pods currently parked in the quarantine queue (terminal until an "
+    "operator or a real spec update intervenes).",
+))
+quarantine_releases = registry.register(Counter(
+    "scheduler_quarantine_releases_total",
+    "Held pods released back to the activeQ after their quarantine "
+    "hold expired (bounded retries before parking).",
+))
+carry_audit_sweeps = registry.register(Counter(
+    "scheduler_tpu_carry_audit_sweeps_total",
+    "Carry integrity audits run (cheap on-device checksum of the "
+    "resident req/nzr/alloc/valid state against the host shadow), by "
+    "disposition (clean / mismatch / busy / idle / raced).",
+    ("disposition",),
+))
+carry_audit_mismatches = registry.register(Counter(
+    "scheduler_tpu_carry_audit_mismatches_total",
+    "Device-resident carry arrays whose audit checksum diverged from "
+    "the host shadow (silent corruption caught before it mis-places "
+    "pods), by array.",
+    ("array",),
+))
+carry_audit_heals = registry.register(Counter(
+    "scheduler_tpu_carry_audit_heals_total",
+    "Corrupted device-resident state self-healed through the counted "
+    "re-upload path after an audit mismatch.",
+))
+device_lost_events = registry.register(Counter(
+    "scheduler_tpu_device_lost_total",
+    "Device-loss events: all resident state dropped, in-flight batches "
+    "recovered through the requeue machinery, state rebuilt from the "
+    "host cache via the cold-upload path.",
+))
+device_rebuild_ms = registry.register(Histogram(
+    "scheduler_tpu_device_rebuild_ms",
+    "Device-loss rebuild latency: loss detection to the first jitted "
+    "solve landing on the re-uploaded state, milliseconds.",
+    buckets=(5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000),
+))
 pod_to_bind_quantile = registry.register(Gauge(
     "scheduler_pod_to_bind_quantile_seconds",
     "Live streaming estimate of the pod-to-bind latency quantile "

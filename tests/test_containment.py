@@ -1,0 +1,564 @@
+"""Blast-radius containment (ISSUE 14): poison-pod bisection +
+quarantine, the ladder_exhausted crash-loop fix, the carry integrity
+audit, and device-loss rebuild.
+
+The contracts under test:
+
+- randomized differential: seeded bursts with 1-3 poison pods at random
+  offsets -- bisection isolates EXACTLY the stamped pods, every healthy
+  pod's placement equals the no-poison oracle run, and quarantined pods
+  carry the typed PodQuarantined condition;
+- a batch that exhausts the ladder twice in a row books
+  ``exhausted_crashloops`` and takes containment instead of a third
+  identical retry (the old unbounded retry storm);
+- carry corruption: the audit detects a silently corrupted
+  device-resident row (invisible to the generation handshake), heals it
+  through the counted-upload path, and placements stay capacity-safe;
+- device loss: resident state rebuilds from the host cache through the
+  cold-upload path, metered, with everything still binding;
+- the poison-chaos tier-1 guard: a 1k-pod burst with the builtin
+  profile -- 100% of healthy pods bind, device-dominant, bounded
+  retries, and the flight-recorder dump alone reconstructs every
+  bisection and quarantine event.
+"""
+
+import json
+import random
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.robustness.circuit import RetryPolicy
+from kubernetes_tpu.robustness.containment import (
+    QUARANTINE_CONDITION,
+    ContainmentConfig,
+)
+from kubernetes_tpu.robustness.faults import (
+    FaultInjector,
+    FaultPoint,
+    FaultProfile,
+    POISON_ANNOTATION,
+    PointConfig,
+    install_injector,
+    load_profile,
+)
+from kubernetes_tpu.robustness.ladder import RobustnessConfig
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+from kubernetes_tpu.utils import flightrecorder, metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    yield
+    install_injector(None)
+
+
+@pytest.fixture
+def thread_crashes(monkeypatch):
+    crashes = []
+    monkeypatch.setattr(
+        threading, "excepthook", lambda args: crashes.append(args)
+    )
+    return crashes
+
+
+def _mk_cluster(
+    num_nodes=16, max_batch=128, containment=None, capacity_cpu="32",
+):
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    sched = new_scheduler(
+        client, informers, batch=True, max_batch=max_batch,
+        robustness_config=RobustnessConfig(
+            solve_timeout_seconds=10.0,
+            failure_threshold=3,
+            cooloff_seconds=0.2,
+            probe_batches=1,
+            retry=RetryPolicy(
+                max_attempts=1, backoff_seconds=0.01,
+                max_backoff_seconds=0.02,
+            ),
+        ),
+        containment_config=containment or ContainmentConfig(
+            max_strikes=3, base_hold_seconds=0.1, max_hold_seconds=0.5,
+        ),
+    )
+    # fast requeue clocks so quarantine convergence isn't dominated by
+    # the reference's 1s initial backoff
+    sched.queue._initial_backoff = 0.1
+    sched.queue._max_backoff = 0.5
+    for i in range(num_nodes):
+        client.create_node(
+            make_node(f"node-{i}")
+            .capacity(cpu=capacity_cpu, memory="64Gi", pods=110)
+            .obj()
+        )
+    informers.start()
+    informers.wait_for_cache_sync()
+    sched.queue.run()
+    return server, client, informers, sched
+
+
+def _wait(predicate, timeout, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def _bound_map(client):
+    pods, _ = client.list_pods()
+    return {
+        p.metadata.name: p.spec.node_name
+        for p in pods if p.spec.node_name
+    }
+
+
+def _overcommitted_nodes(client):
+    """Nodes whose bound pods' cpu requests exceed capacity (the
+    zero-wrong-placements invariant)."""
+    nodes, _ = client.list_nodes()
+    cap = {
+        n.metadata.name: n.status.allocatable.get("cpu", 0)
+        for n in nodes
+    }
+    used = {}
+    pods, _ = client.list_pods()
+    for p in pods:
+        if not p.spec.node_name:
+            continue
+        req = sum(
+            c.resources.requests.get("cpu", 0) for c in p.spec.containers
+        )
+        used[p.spec.node_name] = used.get(p.spec.node_name, 0) + req
+    return [
+        n for n, u in used.items() if cap.get(n) is not None and u > cap[n]
+    ]
+
+
+class TestPoisonBisectionDifferential:
+    def test_random_offsets_match_no_poison_oracle(self, thread_crashes):
+        """Seeded trials: 1-3 poison pods at random offsets in a
+        60-pod burst. Bisection isolates exactly the stamped pods (all
+        parked with the typed condition), and every healthy placement
+        equals the oracle run without the poison pods."""
+        rng = random.Random(20260804)
+        for trial in range(2):
+            n_poison = rng.randint(1, 3)
+            offsets = sorted(rng.sample(range(60), n_poison))
+            poison_names = {f"t{trial}-p{i}" for i in offsets}
+
+            def run(with_poison):
+                server, client, informers, sched = _mk_cluster(
+                    num_nodes=12, capacity_cpu="16"
+                )
+                if with_poison:
+                    install_injector(FaultInjector(FaultProfile(
+                        "poison-differential", seed=trial, points={}
+                    )))
+                try:
+                    for i in range(60):
+                        name = f"t{trial}-p{i}"
+                        if name in poison_names and not with_poison:
+                            continue  # oracle: poison pods absent
+                        pw = make_pod(name).container(
+                            cpu="750m", memory="512Mi"
+                        )
+                        if with_poison and name in poison_names:
+                            pw.annotation(POISON_ANNOTATION, "true")
+                        client.create_pod(pw.obj())
+                    sched.start()
+                    healthy = {
+                        f"t{trial}-p{i}" for i in range(60)
+                    } - poison_names
+                    assert _wait(
+                        lambda: healthy <= set(_bound_map(client)), 60
+                    ), "healthy pods did not all bind"
+                    if with_poison:
+                        assert _wait(
+                            lambda: sched.queue.quarantine_parked_count()
+                            == len(poison_names),
+                            60,
+                        ), "poison pods did not all park"
+                    sched.wait_for_inflight_binds()
+                    placements = _bound_map(client)
+                    parked = {
+                        pi.pod.metadata.name
+                        for pi in sched.queue.quarantined_pods()
+                    }
+                    conditions = {}
+                    for name in poison_names:
+                        if not with_poison:
+                            break
+                        live = client.get_pod("default", name)
+                        conditions[name] = [
+                            c.type for c in live.status.conditions
+                            if c.status == "True"
+                        ]
+                    return placements, parked, conditions, sched
+                finally:
+                    sched.stop()
+                    informers.stop()
+                    install_injector(None)
+
+            placements, parked, conditions, sched = run(True)
+            oracle, _, _, _ = run(False)
+
+            # exactly the stamped pods were isolated
+            assert parked == poison_names
+            # none of the poison pods bound
+            assert not poison_names & set(placements)
+            # typed condition on every quarantined pod
+            for name in poison_names:
+                assert QUARANTINE_CONDITION in conditions[name], (
+                    name, conditions[name]
+                )
+            # healthy placements equal the no-poison oracle
+            for name, node in oracle.items():
+                assert placements.get(name) == node, (
+                    f"trial {trial}: {name} placed on "
+                    f"{placements.get(name)} vs oracle {node}"
+                )
+            assert not thread_crashes, [
+                str(c.exc_value) for c in thread_crashes
+            ]
+
+
+class TestExhaustedCrashloop:
+    def test_singleton_poison_trips_crashloop_then_parks(
+        self, thread_crashes
+    ):
+        """A lone poison pod used to be an unbounded retry storm
+        (exhaust -> sequential fail -> backoff -> exhaust -> ...).
+        Now the second identical exhaustion books exhausted_crashloops
+        and strikes it into quarantine; the budget parks it."""
+        server, client, informers, sched = _mk_cluster(num_nodes=4)
+        install_injector(FaultInjector(FaultProfile(
+            "lone-poison", seed=0, points={}
+        )))
+        crashloops_before = metrics.exhausted_crashloops.value()
+        sched.start()
+        client.create_pod(
+            make_pod("poison-solo").container(cpu="100m")
+            .annotation(POISON_ANNOTATION, "true").obj()
+        )
+        assert _wait(
+            lambda: sched.queue.quarantine_parked_count() == 1, 60
+        ), "lone poison pod never parked"
+        assert (
+            metrics.exhausted_crashloops.value() > crashloops_before
+        ), "crash loop was never booked"
+        # bounded: strikes stopped at the budget, no retry storm
+        assert sched.quarantine.parks == 1
+        assert (
+            sched.quarantine.isolations
+            <= sched.containment_config.max_strikes
+        )
+        live = client.get_pod("default", "poison-solo")
+        assert any(
+            c.type == QUARANTINE_CONDITION and c.status == "True"
+            for c in live.status.conditions
+        )
+        # healthy traffic still flows after the park
+        client.create_pod(make_pod("after").container(cpu="100m").obj())
+        assert _wait(lambda: "after" in _bound_map(client), 30)
+        sched.wait_for_inflight_binds()
+        assert not thread_crashes, [
+            str(c.exc_value) for c in thread_crashes
+        ]
+        sched.stop()
+        informers.stop()
+
+    def test_spec_update_releases_parked_pod(self):
+        """Operator intervention: a REAL spec/label update releases a
+        parked pod for a fresh attempt (status-only writes -- including
+        our own condition -- never do)."""
+        server, client, informers, sched = _mk_cluster(num_nodes=4)
+        install_injector(FaultInjector(FaultProfile(
+            "release", seed=0, points={}
+        )))
+        sched.start()
+        client.create_pod(
+            make_pod("cured").container(cpu="100m")
+            .annotation(POISON_ANNOTATION, "true").obj()
+        )
+        assert _wait(
+            lambda: sched.queue.quarantine_parked_count() == 1, 60
+        )
+        # "fix" the pod: drop the poison annotation (a real update)
+        def fix(p):
+            # copy-on-write apiserver: REPLACE nested collections (an
+            # in-place pop would mutate the shared old object and make
+            # the update look like a no-op to the informer diff)
+            p.metadata.annotations = {
+                k: v for k, v in p.metadata.annotations.items()
+                if k != POISON_ANNOTATION
+            }
+            p.metadata.labels = {**p.metadata.labels, "fixed": "true"}
+
+        server.guaranteed_update("Pod", "default", "cured", fix)
+        assert _wait(lambda: "cured" in _bound_map(client), 30), (
+            "released pod did not bind"
+        )
+        assert sched.queue.quarantine_parked_count() == 0
+        # the typed condition must not outlive the park: the release
+        # hook clears it from the apiserver
+        assert _wait(
+            lambda: not any(
+                c.type == QUARANTINE_CONDITION
+                for c in client.get_pod(
+                    "default", "cured"
+                ).status.conditions
+            ),
+            10,
+        ), "PodQuarantined condition outlived the release"
+        # and the parked gauge refreshed down with the release
+        assert metrics.quarantine_parked.value() == 0
+        sched.stop()
+        informers.stop()
+
+
+class TestCarryIntegrityAudit:
+    def test_corrupt_detect_heal_zero_wrong_placements(
+        self, thread_crashes
+    ):
+        """CARRY_CORRUPT flips a resident row the generation handshake
+        cannot see (it compares host vs shadow, never the device). The
+        audit's device checksums catch it, heal through the
+        counted-upload path, and placements stay capacity-safe with
+        batches in flight before and after."""
+        server, client, informers, sched = _mk_cluster(
+            num_nodes=8, max_batch=32
+        )
+        sched.start()
+        names1 = [f"w1-{i}" for i in range(40)]
+        for n in names1:
+            client.create_pod(
+                make_pod(n).container(cpu="250m", memory="256Mi").obj()
+            )
+        assert _wait(
+            lambda: set(names1) <= set(_bound_map(client)), 60
+        )
+        sched.wait_for_inflight_binds()
+        # audit on the warm, uncorrupted carry: clean (retry through
+        # transient busy/raced dispositions)
+        assert _wait(
+            lambda: sched.audit_carry() in ("clean", "idle"), 10
+        )
+        uploads_before = sched.state_uploads
+
+        inj = FaultInjector(FaultProfile(
+            "corrupt", seed=0,
+            points={FaultPoint.CARRY_CORRUPT: PointConfig(
+                rate=1.0, max_fires=1
+            )},
+        ))
+        install_injector(inj)
+        # one more commit fires the corruption onto the resident carry
+        client.create_pod(
+            make_pod("trigger").container(cpu="100m").obj()
+        )
+        assert _wait(lambda: "trigger" in _bound_map(client), 30)
+        sched.wait_for_inflight_binds()
+        assert _wait(
+            lambda: inj.fired_count(FaultPoint.CARRY_CORRUPT) == 1, 10
+        )
+
+        # detect + heal
+        mm_before = metrics.carry_audit_mismatches.value(array="req")
+        assert _wait(lambda: sched.audit_carry() == "mismatch", 10), (
+            "audit never detected the corrupted row"
+        )
+        assert metrics.carry_audit_mismatches.value(
+            array="req"
+        ) > mm_before
+        assert sched.carry_audit_heals >= 1
+
+        # post-heal traffic: binds, re-upload counted, audit clean
+        names2 = [f"w2-{i}" for i in range(40)]
+        for n in names2:
+            client.create_pod(
+                make_pod(n).container(cpu="250m", memory="256Mi").obj()
+            )
+        assert _wait(
+            lambda: set(names2) <= set(_bound_map(client)), 60
+        )
+        sched.wait_for_inflight_binds()
+        assert sched.state_uploads > uploads_before, (
+            "heal never took the counted-upload path"
+        )
+        assert _wait(lambda: sched.audit_carry() == "clean", 10)
+        # zero wrong placements: no node over capacity
+        assert not _overcommitted_nodes(client)
+        assert not thread_crashes, [
+            str(c.exc_value) for c in thread_crashes
+        ]
+        sched.stop()
+        informers.stop()
+
+
+class TestDeviceLossRebuild:
+    def test_device_lost_rebuilds_and_everything_binds(
+        self, thread_crashes
+    ):
+        server, client, informers, sched = _mk_cluster(
+            num_nodes=8, max_batch=64
+        )
+        sched.start()
+        names1 = [f"a-{i}" for i in range(30)]
+        for n in names1:
+            client.create_pod(
+                make_pod(n).container(cpu="100m", memory="128Mi").obj()
+            )
+        assert _wait(
+            lambda: set(names1) <= set(_bound_map(client)), 60
+        )
+        sched.wait_for_inflight_binds()
+        lost_before = metrics.device_lost_events.value()
+        rebuilds_before = metrics.device_rebuild_ms.count()
+        install_injector(FaultInjector(FaultProfile(
+            "device-loss", seed=0,
+            points={FaultPoint.DEVICE_LOST: PointConfig(
+                rate=1.0, max_fires=1
+            )},
+        )))
+        names2 = [f"b-{i}" for i in range(30)]
+        for n in names2:
+            client.create_pod(
+                make_pod(n).container(cpu="100m", memory="128Mi").obj()
+            )
+        assert _wait(
+            lambda: set(names2) <= set(_bound_map(client)), 60
+        ), "post-loss wave did not bind"
+        sched.wait_for_inflight_binds()
+        assert metrics.device_lost_events.value() == lost_before + 1
+        assert metrics.device_rebuild_ms.count() == rebuilds_before + 1, (
+            "detection -> rebuilt was never metered"
+        )
+        assert not _overcommitted_nodes(client)
+        assert not thread_crashes, [
+            str(c.exc_value) for c in thread_crashes
+        ]
+        sched.stop()
+        informers.stop()
+
+
+class TestPoisonChaosGuard:
+    def test_poison_chaos_1k_burst_tier1_guard(self, thread_crashes):
+        """The tier-1 acceptance guard: a 1k-pod burst under the
+        builtin poison-chaos profile (3 stamped poison pods + one
+        carry corruption + one device loss). 100% of healthy pods
+        bind, placements device-dominant (>90%), zero unbounded
+        retries, and the flight-recorder dump ALONE reconstructs every
+        bisection and quarantine event."""
+        flightrecorder.RECORDER.reset()
+        server, client, informers, sched = _mk_cluster(
+            num_nodes=48, max_batch=256
+        )
+        profile = load_profile("poison-chaos", seed=7)
+        inj = FaultInjector(profile)
+        install_injector(inj)
+        sched.start()
+        names = [f"pc-{i}" for i in range(1000)]
+        for n in names:
+            client.create_pod(
+                make_pod(n).container(cpu="500m", memory="256Mi").obj()
+            )
+        # settled state: every stamped pod parked, every healthy pod
+        # bound, nothing left circulating (a 0 == 0 early read must
+        # not pass, so the predicate requires at least one stamp)
+        def settled():
+            counts = sched.queue.num_pending()
+            fired = inj.fired_count(FaultPoint.POISON_POD)
+            return (
+                fired >= 1
+                and counts.get("active", 0) == 0
+                and counts.get("backoff", 0) == 0
+                and counts.get("unschedulable", 0) == 0
+                and counts.get("quarantined", 0) == 0
+                and sched.queue.quarantine_parked_count() == fired
+                and len(_bound_map(client)) == len(names) - fired
+            )
+
+        assert _wait(settled, 300, interval=0.2), (
+            f"never settled: pending={sched.queue.num_pending()} "
+            f"bound={len(_bound_map(client))} "
+            f"fired={inj.fired_count(FaultPoint.POISON_POD)}"
+        )
+        stamped = {
+            pi.pod.metadata.name
+            for pi in sched.queue.quarantined_pods()
+        }
+        healthy = set(names) - stamped
+        sched.wait_for_inflight_binds()
+        assert inj.fired_count(FaultPoint.POISON_POD) >= 1
+
+        bound = _bound_map(client)
+        assert healthy <= set(bound)
+        assert not stamped & set(bound), "a poison pod bound"
+        # device-dominant: >90% of bound pods placed by a device solve
+        assert sched.pods_solved_on_device >= 0.9 * len(bound), (
+            f"device placed {sched.pods_solved_on_device} of "
+            f"{len(bound)}"
+        )
+        # zero unbounded retries: the whole run's isolations are
+        # bounded by stamped * strike budget, and nothing crash-spun
+        assert (
+            sched.quarantine.isolations
+            <= len(stamped) * sched.containment_config.max_strikes
+        )
+        assert sched.quarantine.parks == len(stamped)
+        assert not _overcommitted_nodes(client)
+        assert not thread_crashes, [
+            str(c.exc_value) for c in thread_crashes
+        ]
+
+        # -- reconstruction from the dump alone (JSON round trip) -----
+        d = json.loads(flightrecorder.RECORDER.dump_json())
+        marks = d["marks"]
+        bisect_starts = [m for m in marks if m["kind"] == "bisect_start"]
+        bisect_ends = [
+            m for m in marks
+            if m["kind"] in ("bisect_done", "bisect_abort")
+        ]
+        isolated_marks = [
+            m for m in marks if m["kind"] == "bisect_isolated"
+        ]
+        quarantine_marks = [
+            m for m in marks if m["kind"] == "quarantine"
+        ]
+        assert len(bisect_starts) == sched.bisections
+        assert len(bisect_ends) == sched.bisections
+        assert len(quarantine_marks) == sched.quarantine.isolations
+        parked_marks = {
+            m["pod"] for m in quarantine_marks
+            if m["disposition"] == "parked"
+        }
+        parked_uids = {
+            pi.pod.metadata.uid
+            for pi in sched.queue.quarantined_pods()
+        }
+        assert parked_marks == parked_uids
+        # every isolation the ledger booked is attributable to a
+        # bisect_isolated or crashloop-driven quarantine mark
+        assert len(isolated_marks) <= len(quarantine_marks)
+        # the poison fault marks round-trip against the injector ledger
+        fault_marks = [
+            m for m in marks
+            if m["kind"] == "fault"
+            and m["point"] == FaultPoint.POISON_POD
+        ]
+        assert len(fault_marks) == inj.fired_count(
+            FaultPoint.POISON_POD
+        )
+        sched.stop()
+        informers.stop()
+        assert not sched.commit_degraded

@@ -175,6 +175,19 @@ class TestMetricsEndpointE2E:
         assert "scheduler_tpu_state_uploads_total" in body
         assert "scheduler_pod_to_bind_quantile_seconds" in body
         assert 'q="0.99"' in body
+        # blast-radius containment families (ISSUE 14): registered in
+        # the default registry so dashboards can alert on a quarantine
+        # or audit mismatch the moment the first one books
+        assert "scheduler_tpu_bisections_total" in body
+        assert "scheduler_tpu_bisect_subsolves_total" in body
+        assert "scheduler_ladder_exhausted_crashloops_total" in body
+        assert "scheduler_quarantine_pods_total" in body
+        assert "scheduler_quarantine_parked" in body
+        assert "scheduler_quarantine_releases_total" in body
+        assert "scheduler_tpu_carry_audit_sweeps_total" in body
+        assert "scheduler_tpu_carry_audit_mismatches_total" in body
+        assert "scheduler_tpu_device_lost_total" in body
+        assert "scheduler_tpu_device_rebuild_ms" in body
         # and the quantile gauge carries a real estimate post-burst
         p99 = metrics.pod_to_bind_quantile.value(q="0.99")
         assert p99 > 0.0

@@ -775,6 +775,131 @@ def bench_preemption_wave(num_nodes: int, wave: int = 256):
     return out
 
 
+def bench_bisect(burst: int, num_nodes: int = 64):
+    """ISSUE-14 satellite: blast-radius containment cost. One poison
+    pod in a ``burst``-wide batch -- the bisection path (O(log B)
+    sub-solves on the already-warm pad rungs; healthy pods commit at
+    the device tier) vs the old full-ladder fail (the whole batch
+    walks the per-pod sequential oracle). Sub-solves pad to the warmed
+    max_batch rung, so the run must finish with ZERO mid-run
+    recompiles -- asserted via the PR-13 jit-cache watchdog's own
+    probe (jit_cache_sizes), not a heuristic."""
+    import time as _time
+
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.client.client import Client
+    from kubernetes_tpu.client.informer import InformerFactory
+    from kubernetes_tpu.ops.assignment import jit_cache_sizes
+    from kubernetes_tpu.robustness.circuit import RetryPolicy
+    from kubernetes_tpu.robustness.containment import ContainmentConfig
+    from kubernetes_tpu.robustness.faults import (
+        FaultInjector,
+        FaultProfile,
+        POISON_ANNOTATION,
+        install_injector,
+    )
+    from kubernetes_tpu.robustness.ladder import RobustnessConfig
+    from kubernetes_tpu.scheduler.scheduler import new_scheduler
+    from kubernetes_tpu.testing import make_node, make_pod
+    from kubernetes_tpu.utils import metrics
+
+    def run_arm(containment_enabled: bool):
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(
+            client, informers, batch=True, max_batch=burst,
+            robustness_config=RobustnessConfig(
+                solve_timeout_seconds=30.0,
+                failure_threshold=burst,  # breakers out of the picture
+                cooloff_seconds=0.1,
+                retry=RetryPolicy(
+                    max_attempts=1, backoff_seconds=0.0,
+                    max_backoff_seconds=0.0,
+                ),
+            ),
+            containment_config=ContainmentConfig(
+                enabled=containment_enabled,
+                max_strikes=1,  # isolate -> park immediately: the arm
+                # measures the bisection search, not the hold schedule
+            ),
+        )
+        sched.queue._initial_backoff = 0.05
+        sched.queue._max_backoff = 0.1
+        for i in range(num_nodes):
+            client.create_node(
+                make_node(f"n{i}")
+                .capacity(cpu="64", memory="256Gi", pods=1100)
+                .obj()
+            )
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        sched.warmup()  # pad rungs compiled OFF the measured clock
+        sizes_before = dict(jit_cache_sizes(None))
+        install_injector(FaultInjector(FaultProfile(
+            "bench-bisect", seed=0, points={}
+        )))
+        healthy = set()
+        for i in range(burst):
+            pw = make_pod(f"b-{i}").container(cpu="100m", memory="64Mi")
+            if i == burst // 2:
+                pw.annotation(POISON_ANNOTATION, "true")
+            else:
+                healthy.add(f"b-{i}")
+            client.create_pod(pw.obj())
+        t0 = _time.perf_counter()
+        sched.start()
+        deadline = _time.time() + 300
+        while _time.time() < deadline:
+            pods, _ = client.list_pods()
+            if healthy <= {
+                p.metadata.name for p in pods if p.spec.node_name
+            }:
+                break
+            _time.sleep(0.005)
+        elapsed_ms = (_time.perf_counter() - t0) * 1000.0
+        sched.wait_for_inflight_binds()
+        recompiles = sum(
+            max(0, n - sizes_before.get(sig, 0))
+            for sig, n in jit_cache_sizes(None).items()
+        )
+        out = (
+            elapsed_ms,
+            sched.bisections,
+            float(metrics.bisect_subsolves.value()),
+            recompiles,
+        )
+        install_injector(None)
+        # the old-path arm leaves the poison pod cycling through the
+        # sequential floor forever (the storm this bench quantifies):
+        # delete it so teardown doesn't race a live retry
+        try:
+            client.delete_pod("default", f"b-{burst // 2}")
+        except Exception:
+            pass
+        _time.sleep(0.1)
+        sched.stop()
+        informers.stop()
+        return out
+
+    sub0 = float(metrics.bisect_subsolves.value())
+    bisect_ms, bisections, sub1, rec_b = run_arm(True)
+    old_ms, _, _, rec_o = run_arm(False)
+    assert rec_b == 0, (
+        f"bisection arm recompiled {rec_b} signature(s) mid-run -- "
+        f"sub-solves must reuse the warmed pad rungs"
+    )
+    return {
+        f"bisect_b{burst}_ms": bisect_ms,
+        f"bisect_b{burst}_subsolves": int(sub1 - sub0),
+        f"bisect_b{burst}_bisections": bisections,
+        f"bisect_b{burst}_recompiles": rec_b,
+        f"bisect_b{burst}_oldpath_ms": old_ms,
+        f"bisect_b{burst}_oldpath_recompiles": rec_o,
+    }
+
+
 def bench_watch_fanout(events: int = 20000):
     """Apiserver watch fan-out under N consumers (the partitioned
     control plane runs one full informer set PER STACK): broadcast
@@ -1201,6 +1326,9 @@ def main() -> None:
     fanout = bench_watch_fanout()
     ingest = bench_ingest()
     trace_overhead = bench_trace_overhead()
+    bisect = {}
+    for b in (256, 1024):
+        bisect.update(bench_bisect(b))
 
     record = {
         "metric": "hotpath_microbench",
@@ -1253,6 +1381,12 @@ def main() -> None:
         }
     )
     record.update(trace_overhead)
+    record.update(
+        {
+            k: (v if isinstance(v, int) else round(v, 2))
+            for k, v in bisect.items()
+        }
+    )
     print(json.dumps(record))
 
 
