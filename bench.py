@@ -1163,6 +1163,16 @@ def main() -> None:
         "breakdown (pop_batch / pack / device_solve / download / "
         "commit, emitted as profile_stage_seconds in every record)",
     )
+    ap.add_argument(
+        "--tenancy", action="store_true",
+        default=os.environ.get("BENCH_TENANCY", "") == "1",
+        help="arm the multi-tenant fairness plane (QuotaController "
+        "admission gate + DRF dominant-share solve-order bias, "
+        "scheduler/tenancy.py) on the closed-loop burst -- with no "
+        "ResourceQuota objects and one namespace this measures the "
+        "armed plane's single-tenant overhead (the <5%% headline "
+        "guard for ISSUE 15)",
+    )
     args = ap.parse_args()
 
     if args.fault_profile == "ha-chaos":
@@ -1208,6 +1218,11 @@ def main() -> None:
     client = Client(server)
     informers = InformerFactory(server)
     sched = new_scheduler(client, informers, batch=True, max_batch=max_batch)
+    quota_ctrl = None
+    if args.tenancy:
+        from kubernetes_tpu.scheduler.tenancy import arm_tenancy
+
+        quota_ctrl = arm_tenancy(sched, client, informers)
 
     for i in range(num_nodes):
         client.create_node(
@@ -1218,6 +1233,9 @@ def main() -> None:
     informers.start()
     informers.wait_for_cache_sync()
     sched.queue.run()
+    if quota_ctrl is not None:
+        quota_ctrl.sync_all()
+        quota_ctrl.start()
 
     # Compile every solver variant off the clock, then run a small warm
     # burst through the full pipeline (binds, informer echo, commit path).
@@ -1326,6 +1344,13 @@ def main() -> None:
         # --profile re-run bisect
         "profile_stage_seconds": median.get("profile_stage_seconds", {}),
     }
+    if quota_ctrl is not None:
+        # tenancy-armed runs are labeled so an A/B against the unarmed
+        # headline is machine-readable (the <5% single-tenant guard)
+        quota_ctrl.stop()
+        record["tenancy_armed"] = True
+        record["quota_grants"] = quota_ctrl.admissions_granted
+        record["quota_denials"] = quota_ctrl.admissions_denied
     if fault_profile:
         # chaos runs report the degradation profile next to throughput
         record["fault_profile"] = fault_profile

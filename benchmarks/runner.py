@@ -709,6 +709,42 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         if streaming.band_priority_threshold is not None:
             sched.queue.band_threshold = streaming.band_priority_threshold
 
+    # workload-scoped multi-tenant fairness plane (ISSUE 15): pods
+    # spread over `namespaces:` tenants, optional per-namespace
+    # ResourceQuota hard caps, the QuotaController admission gate, and
+    # the DRF dominant-share solve-order bias. Counters land in the
+    # row's tenant_* labels (Jain bind-fairness index, dominant-share
+    # spread, quota denials/refunds/parked).
+    n_namespaces = int(wl.get("namespaces", 1))
+    tenancy_cfg = wl.get("tenancy")
+    quota_ctrl = None
+    tenancy_stoppers: List[Any] = []
+    if tenancy_cfg is not None or n_namespaces > 1:
+        from kubernetes_tpu.scheduler.tenancy import arm_tenancy
+
+        tenancy_cfg = tenancy_cfg or {}
+        quota_ctrl = arm_tenancy(sched, client, informers)
+        tenancy_stoppers.append(quota_ctrl)
+    quota_spec = wl.get("quota")
+    if quota_spec:
+        from kubernetes_tpu.api.resource import parse_cpu, parse_memory
+        from kubernetes_tpu.api.types import ResourceQuota
+        from kubernetes_tpu.api.types import ObjectMeta as _QOM
+
+        hard: Dict[str, int] = {}
+        for rname, qty in quota_spec.items():
+            if rname == "cpu":
+                hard["cpu"] = parse_cpu(qty)
+            elif rname == "memory":
+                hard["memory"] = parse_memory(qty)
+            else:
+                hard[rname] = int(qty)
+        for t in range(max(1, n_namespaces)):
+            server.create(ResourceQuota(
+                metadata=_QOM(name="quota", namespace=f"tenant-{t}"),
+                hard=dict(hard),
+            ))
+
     # workload-scoped preemption wave wiring (ISSUE 11): the shared
     # DisruptionController PDB gate on the scheduler's Preemptor (every
     # wave eviction spends can_disrupt -- zero overspend by
@@ -913,6 +949,9 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         informers.start()
         informers.wait_for_cache_sync()
         sched.queue.run()
+        if quota_ctrl is not None:
+            quota_ctrl.sync_all()
+            quota_ctrl.start()
         sched.warmup()
 
         # -- init fill (off the clock) ------------------------------------------
@@ -991,7 +1030,38 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
                 p.metadata.labels[POD_GROUP_LABEL] = (
                     f"group-{i // int(gang.get('group_size', 10))}"
                 )
+            if quota_ctrl is not None:
+                # tenant identity IS the namespace: round-robin so every
+                # batch spans tenants (the fairness plane's arbitration
+                # surface)
+                p.metadata.namespace = (
+                    f"tenant-{i % max(1, n_namespaces)}"
+                )
             pods.append(p)
+
+        # quota-churn scenario: raise every tenant's hard caps mid-run
+        # (`quota_scenario: {mode: raise, at_fraction: F, factor: K}`)
+        # -- the parked remainder must wake on the quota events and
+        # bind, pinning the event-driven release path end to end
+        quota_scenario = wl.get("quota_scenario")
+        if quota_scenario and quota_ctrl is not None:
+
+            def _run_quota_scenario(coll_ref=None):
+                frac = float(quota_scenario.get("at_fraction", 0.5))
+                factor = int(quota_scenario.get("factor", 2))
+                _wait_fraction_bound(coll_ref, frac, timeout_s)
+                for t in range(max(1, n_namespaces)):
+                    def grow(obj, _f=factor):
+                        obj.hard = {
+                            name: qty * _f
+                            for name, qty in obj.hard.items()
+                        }
+                    try:
+                        client.update_resource_quota_status(
+                            f"tenant-{t}", "quota", grow
+                        )
+                    except KeyError:
+                        pass
 
         # -- poison seeding (blast-radius containment, ISSUE 14) -----------
         # `poison: {count: N, seed: S}` stamps N measured pods at seeded
@@ -1045,6 +1115,13 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
                 daemon=True,
             )
             scenario_thread.start()
+        quota_thread = None
+        if quota_scenario and quota_ctrl is not None:
+            quota_thread = threading.Thread(
+                target=_run_quota_scenario, args=(coll,),
+                name="quota-scenario", daemon=True,
+            )
+            quota_thread.start()
         ok = True
         streaming_rec: Dict[str, Any] = {}
         if streaming:
@@ -1366,6 +1443,102 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
                 prec["high_priority_unbound"] = unbound
                 result["ok"] = bool(result["ok"]) and unbound == 0
             result["preemption"] = prec
+        if quota_ctrl is not None:
+            # fairness + ledger labels: Jain index over per-tenant bind
+            # counts, the min-tenant share of fair share, the dominant-
+            # share spread, and the quota ledger's counters. Overspend
+            # (any quota's used > hard) fails the row outright -- the
+            # zero-overspend invariant is the acceptance bar.
+            thr0 = (tenancy_cfg or {}).get("high_priority_threshold")
+            if thr0 is not None:
+                # settle: the high band binds through PREEMPTION waves
+                # (evict -> victim termination -> nominee rebind), which
+                # keep landing after the bulk fraction went quiet --
+                # read the inversion verdict only once the band settled
+                # (bounded; a genuinely starved band still fails below)
+                settle_deadline = time.time() + 120
+                while time.time() < settle_deadline:
+                    if not any(
+                        p.spec.priority >= int(thr0)
+                        and not p.spec.node_name
+                        and p.metadata.deletion_timestamp is None
+                        for p in client.list_pods()[0]
+                    ):
+                        break
+                    time.sleep(0.25)
+                sched.wait_for_inflight_binds(timeout=60)
+            per_ns: Dict[str, int] = {}
+            overspend = False
+            all_pods, _rv = client.list_pods()
+            for p in all_pods:
+                if p.spec.node_name and p.metadata.namespace.startswith(
+                    "tenant-"
+                ):
+                    per_ns[p.metadata.namespace] = per_ns.get(
+                        p.metadata.namespace, 0
+                    ) + 1
+            for q, _rv2 in [client.list_resource_quotas()]:
+                for quota_obj in q:
+                    for rname, hard_qty in quota_obj.hard.items():
+                        if quota_obj.status.used.get(rname, 0) > hard_qty:
+                            overspend = True
+            counts = [
+                per_ns.get(f"tenant-{t}", 0)
+                for t in range(max(1, n_namespaces))
+            ]
+            total_bound = sum(counts)
+            jain = 0.0
+            if total_bound:
+                jain = (total_bound ** 2) / (
+                    len(counts) * sum(c * c for c in counts)
+                )
+            fair = total_bound / max(1, len(counts))
+            min_fair_frac = (
+                min(counts) / fair if fair > 0 else 1.0
+            )
+            tt = getattr(sched, "tenant_shares", None)
+            trec: Dict[str, Any] = {
+                "namespaces": n_namespaces,
+                "jain_bind_index": round(jain, 4),
+                "min_fair_fraction": round(min_fair_frac, 4),
+                "max_dominant_share": (
+                    round(tt.max_share(), 4) if tt is not None else 0.0
+                ),
+                "dominant_share_spread": (
+                    round(tt.share_spread(), 4) if tt is not None else 0.0
+                ),
+                "quota_denials": quota_ctrl.admissions_denied,
+                "quota_grants": quota_ctrl.admissions_granted,
+                "quota_refunds": quota_ctrl.refunds,
+                "quota_releases": quota_ctrl.releases,
+                "quota_parked": sched.queue.quota_parked_count(),
+                "overspend": overspend,
+            }
+            result["tenant"] = trec
+            result["ok"] = bool(result["ok"]) and not overspend
+            min_jain = (tenancy_cfg or {}).get("min_jain")
+            if min_jain is not None:
+                result["ok"] = bool(result["ok"]) and (
+                    jain >= float(min_jain)
+                )
+            min_ff = (tenancy_cfg or {}).get("min_fair_fraction")
+            if min_ff is not None:
+                result["ok"] = bool(result["ok"]) and (
+                    min_fair_frac >= float(min_ff)
+                )
+            thr = (tenancy_cfg or {}).get("high_priority_threshold")
+            if thr is not None:
+                # the multi-tenant inversion pin: every high-band pod
+                # binds even while the bulk flood contends across
+                # tenants and quotas
+                unbound_high = sum(
+                    1 for p in all_pods
+                    if p.spec.priority >= int(thr)
+                    and not p.spec.node_name
+                    and p.metadata.deletion_timestamp is None
+                )
+                trec["high_priority_unbound"] = unbound_high
+                result["ok"] = bool(result["ok"]) and unbound_high == 0
         if lifecycle_counters:
             result["lifecycle"] = lifecycle_counters
         if streaming_rec:
@@ -1395,6 +1568,11 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
             except Exception:  # noqa: BLE001 - teardown keeps going
                 pass
         for comp in preempt_stoppers:
+            try:
+                comp.stop()
+            except Exception:  # noqa: BLE001 - teardown keeps going
+                pass
+        for comp in tenancy_stoppers:
             try:
                 comp.stop()
             except Exception:  # noqa: BLE001 - teardown keeps going
@@ -1445,6 +1623,12 @@ def to_data_items(results: List[Dict[str, Any]]) -> Dict[str, Any]:
             {
                 f"preemption_{k}": str(v)
                 for k, v in (r.get("preemption") or {}).items()
+            }
+        )
+        labels.update(
+            {
+                f"tenant_{k}": str(v)
+                for k, v in (r.get("tenant") or {}).items()
             }
         )
         if r.get("error") or not r.get("ok", False):

@@ -576,6 +576,39 @@ class Binding:
 
 
 @dataclass
+class ResourceQuotaStatus:
+    """core/v1 ResourceQuotaStatus: the ledger half of the object.
+    ``hard`` echoes the enforced spec at last reconcile; ``used`` is the
+    per-namespace consumption the QuotaController maintains through
+    guaranteed_update check-and-increment (the multi-tenant admission
+    gate's source of truth)."""
+
+    hard: ResourceList = field(default_factory=dict)
+    used: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class ResourceQuota:
+    """core/v1 ResourceQuota (namespace-scoped hard caps). ``hard`` maps
+    resource name -> base-unit integer limit in the same units as pod
+    requests (cpu in milliCPU, memory in bytes, "pods" as a count,
+    extended resources in whole units), so the admission arithmetic is
+    pure integer adds against ``pod_resource_requests``. The scheduler
+    enforces it at the scheduling gate (controllers/quota.py): a pod
+    whose namespace has no headroom parks typed-QuotaExceeded instead of
+    entering a batch."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    hard: ResourceList = field(default_factory=dict)
+    status: ResourceQuotaStatus = field(default_factory=ResourceQuotaStatus)
+
+    kind: str = "ResourceQuota"
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+@dataclass
 class PriorityClass:
     """scheduling.k8s.io/v1 PriorityClass: a named priority value.
     Pods reference one by ``spec.priority_class_name``; the admission

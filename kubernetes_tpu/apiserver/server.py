@@ -222,7 +222,7 @@ class APIServer:
         "Pod", "Node", "PodDisruptionBudget", "PodGroup", "Lease", "Service",
         "PersistentVolume", "PersistentVolumeClaim", "StorageClass",
         "CSINode", "ReplicationController", "ReplicaSet", "StatefulSet",
-        "Secret", "PriorityClass",
+        "Secret", "PriorityClass", "ResourceQuota",
     )
 
     def __init__(self, watch_history_limit: int = 200_000) -> None:

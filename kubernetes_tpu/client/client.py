@@ -102,6 +102,24 @@ class Client:
             "PodDisruptionBudget", namespace, name, mutate
         )
 
+    def create_resource_quota(self, quota) -> object:
+        return self._server.create(quota)
+
+    def list_resource_quotas(self) -> Tuple[List[object], int]:
+        return self._server.list("ResourceQuota")
+
+    def update_resource_quota_status(
+        self, namespace: str, name: str, mutate
+    ) -> object:
+        """resourcequotas/status subresource: the QuotaController's
+        check-and-increment ledger write (atomic under guaranteed_update,
+        so N admission gates contend on the same counter instead of
+        double-spending a stale informer read -- the PDB
+        checkAndDecrement discipline)."""
+        return self._server.guaranteed_update(
+            "ResourceQuota", namespace, name, mutate
+        )
+
     def create_pod_group(self, pg: PodGroup) -> PodGroup:
         return self._server.create(pg)
 

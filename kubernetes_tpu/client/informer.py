@@ -389,6 +389,9 @@ class InformerFactory:
     def priority_classes(self) -> Informer:
         return self.informer("PriorityClass")
 
+    def resource_quotas(self) -> Informer:
+        return self.informer("ResourceQuota")
+
     def start(self) -> None:
         self._started = True
         for inf in list(self._informers.values()):

@@ -27,6 +27,7 @@ from kubernetes_tpu.config.types import (
     ResilienceConfiguration,
     RobustnessConfiguration,
     StreamingConfiguration,
+    TenancyConfiguration,
     TPUSolverConfiguration,
 )
 from kubernetes_tpu.scheduler.extender import ExtenderConfig
@@ -249,6 +250,12 @@ def load_config_from_dict(raw: Dict[str, Any]) -> KubeSchedulerConfiguration:
         zone_aligned=bool(pt_raw.get("zoneAligned", False)),
         resource_namespace=pt_raw.get("resourceNamespace", "kube-system"),
         resource_prefix=pt_raw.get("resourcePrefix", "ksp-partition"),
+    )
+    tn_raw = raw.get("tenancy", {})
+    cfg.tenancy = TenancyConfiguration(
+        enabled=bool(tn_raw.get("enabled", False)),
+        quota_enforcement=bool(tn_raw.get("quotaEnforcement", True)),
+        drf_bias=bool(tn_raw.get("drfBias", True)),
     )
     fi_raw = raw.get("faultInjection", {})
     cfg.fault_injection = FaultInjectionConfiguration(

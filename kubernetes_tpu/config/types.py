@@ -233,6 +233,23 @@ class ContainmentConfiguration:
 
 
 @dataclass
+class TenancyConfiguration:
+    """Multi-tenant fairness plane (scheduler/tenancy.py +
+    controllers/quota.py): the ResourceQuota hard-cap admission gate
+    (exhausted namespaces park typed-QuotaExceeded, woken by quota/
+    usage events) and the DRF dominant-share solve-order bias (within a
+    priority level, the tenant with the lowest dominant share places
+    first -- all solver tiers, zero kernel changes). Off by default:
+    single-tenant deployments pay one is-None check per popped pod."""
+
+    enabled: bool = False
+    #: enforce ResourceQuota objects at the scheduling gate
+    quota_enforcement: bool = True
+    #: arm the dominant-share tracker + fair solve order
+    drf_bias: bool = True
+
+
+@dataclass
 class FaultPointConfiguration:
     """One injection point's firing policy (robustness/faults.py)."""
 
@@ -288,4 +305,7 @@ class KubeSchedulerConfiguration:
     )
     partition: PartitionConfiguration = field(
         default_factory=PartitionConfiguration
+    )
+    tenancy: TenancyConfiguration = field(
+        default_factory=TenancyConfiguration
     )

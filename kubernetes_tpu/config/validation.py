@@ -128,6 +128,14 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
         if ct.bisect_abort_after < 1:
             errors.append("containment.bisectAbortAfter must be >= 1")
 
+    tn = getattr(cfg, "tenancy", None)
+    if tn is not None and tn.enabled:
+        if not tn.quota_enforcement and not tn.drf_bias:
+            errors.append(
+                "tenancy.enabled with both quotaEnforcement and "
+                "drfBias off arms nothing; disable tenancy instead"
+            )
+
     rs = getattr(cfg, "resilience", None)
     if rs is not None:
         if rs.sweep_interval_seconds <= 0:

@@ -12,5 +12,11 @@ from kubernetes_tpu.controllers.nodelifecycle import (
     NodeDrainer,
     NodeLifecycleController,
 )
+from kubernetes_tpu.controllers.quota import QuotaController
 
-__all__ = ["DisruptionController", "NodeDrainer", "NodeLifecycleController"]
+__all__ = [
+    "DisruptionController",
+    "NodeDrainer",
+    "NodeLifecycleController",
+    "QuotaController",
+]

@@ -173,6 +173,15 @@ class PriorityQueue:
         self._quarantine_held: Dict[str, PodInfo] = {}
         self._quarantine_release: Dict[str, float] = {}  # key -> due
         self._quarantine_parked: Dict[str, PodInfo] = {}
+        # multi-tenant hard-quota parking (controllers/quota.py): pods
+        # denied admission by an exhausted ResourceQuota. Parked OUT of
+        # every queue and released by quota/usage EVENTS only (the
+        # QuotaController's headroom recheck) -- cluster events, move
+        # requests, and the flush loops never wake them, because no
+        # node/volume/affinity change can create quota headroom.
+        self._quota_parked: Dict[str, PodInfo] = {}
+        self._quota_parked_ns: Dict[str, set] = {}  # namespace -> keys
+        self._quota_seen = False
         # once quarantine has been used, num_pending keeps emitting the
         # quarantine keys even at zero (a scrape-driven pending_pods
         # gauge must be refreshed DOWN, not left at its last nonzero
@@ -221,6 +230,17 @@ class PriorityQueue:
 
     def _add_locked(self, pod: Pod, now: float) -> None:
         key = _pod_key(pod)
+        qp = self._quota_parked.get(key)
+        if qp is not None:
+            if qp.pod.metadata.uid == pod.metadata.uid:
+                # a re-delivered add (relist echo) for a quota-parked
+                # incarnation must not resurrect it into the activeQ --
+                # only a quota/usage event releases it
+                qp.pod = pod
+                return
+            # a NEW incarnation under the same key: the parked object
+            # is gone; the replacement re-runs the admission gate
+            self._drop_quota_parked_locked(key)
         held = self._quarantine_held.get(key)
         parked = held or self._quarantine_parked.get(key)
         if parked is not None:
@@ -252,6 +272,20 @@ class PriorityQueue:
         self._quarantine_release.pop(key, None)
         if self._quarantine_parked.pop(key, None) is not None:
             metrics.quarantine_parked.set(len(self._quarantine_parked))
+        if self._quota_parked:
+            self._drop_quota_parked_locked(key)
+
+    def _drop_quota_parked_locked(self, key: str) -> None:
+        pi = self._quota_parked.pop(key, None)
+        if pi is None:
+            return
+        ns = pi.pod.metadata.namespace
+        keys = self._quota_parked_ns.get(ns)
+        if keys is not None:
+            keys.discard(key)
+            if not keys:
+                del self._quota_parked_ns[ns]
+        metrics.quota_parked.set(len(self._quota_parked))
 
     def add(self, pod: Pod) -> None:
         """New pending pod (reference :246 Add)."""
@@ -388,6 +422,24 @@ class PriorityQueue:
                     del self.unschedulable_q[key]
                     self.active_q.add(pi)
                     self._cond.notify()
+                return
+            pi = self._quota_parked.get(key)
+            if pi is not None:
+                updated = _is_pod_updated(old_pod, new_pod)
+                pi.pod = new_pod
+                if not updated:
+                    # status-only change (incl. the controller's own
+                    # QuotaExceeded condition write): stay parked
+                    return
+                # a REAL spec/label change is operator intervention
+                # (e.g. the requests were shrunk to fit): release for a
+                # fresh admission attempt at pop. Fresh timestamp, same
+                # as the controller's release path -- park time is not
+                # queue wait
+                self._drop_quota_parked_locked(key)
+                pi.timestamp = self._now()
+                self.active_q.add(pi)
+                self._cond.notify()
                 return
             pi = self._quarantine_held.get(key) or (
                 self._quarantine_parked.get(key)
@@ -734,10 +786,75 @@ class PriorityQueue:
             # a dashboard alert clears when the last parked pod goes
             metrics.quarantine_parked.set(len(self._quarantine_parked))
 
+    def park_quarantined_recovered(self, pod: Pod) -> None:
+        """Startup-recovery park (ROADMAP item 6c): a relisted PENDING
+        pod still carrying the persisted ``PodQuarantined`` condition
+        goes straight back to the terminal park instead of the activeQ
+        -- a restarted scheduler (whose in-memory strike ledger died
+        with the old incarnation) must not re-admit a known poison pod
+        into batches until an operator intervenes. The existing release
+        paths (real spec update via ``update``, delete, new
+        incarnation) apply unchanged."""
+        self.park_quarantined(PodInfo(pod, self._now()))
+
     def _delete_from_queues_locked(self, key: str) -> None:
         self.active_q.delete_by_key(key)
         self.pod_backoff_q.delete_by_key(key)
         self.unschedulable_q.pop(key, None)
+
+    # -- quota parking (multi-tenant fairness plane, controllers/quota.py) ---
+
+    def park_quota_exceeded(self, pi: PodInfo) -> None:
+        """Park an (already popped) pod whose namespace has no quota
+        headroom OUT of every queue. Unlike unschedulableQ parking,
+        cluster events never wake it -- no node/volume change can
+        create quota headroom; the QuotaController releases it on
+        quota-update or usage-drop events (and only when it would
+        actually fit, so releases never churn)."""
+        with self._cond:
+            key = _info_key(pi)
+            self._quota_seen = True
+            self._delete_from_queues_locked(key)
+            self._quota_parked[key] = pi
+            self._quota_parked_ns.setdefault(
+                pi.pod.metadata.namespace, set()
+            ).add(key)
+            metrics.quota_parked.set(len(self._quota_parked))
+
+    def release_quota_parked(self, pis: List[PodInfo]) -> int:
+        """Move the given parked pods back to the activeQ (the
+        controller's headroom release). Returns the number released."""
+        released = 0
+        with self._cond:
+            now = self._now()
+            for pi in pis:
+                key = _info_key(pi)
+                if key not in self._quota_parked:
+                    continue  # deleted / already released
+                self._drop_quota_parked_locked(key)
+                pi.timestamp = now
+                self.active_q.add(pi)
+                released += 1
+            if released:
+                self._cond.notify_all()
+        return released
+
+    def quota_parked_infos(self, namespace: Optional[str] = None) -> List[PodInfo]:
+        """Parked pods (of one namespace, or all), in park order."""
+        with self._lock:
+            if namespace is None:
+                return list(self._quota_parked.values())
+            keys = self._quota_parked_ns.get(namespace)
+            if not keys:
+                return []
+            return [
+                pi for key, pi in self._quota_parked.items()
+                if key in keys
+            ]
+
+    def quota_parked_count(self) -> int:
+        with self._lock:
+            return len(self._quota_parked)
 
     def flush_quarantine_released(self) -> int:
         """Move held pods whose hold expired back to the activeQ (run
@@ -920,6 +1037,7 @@ class PriorityQueue:
                 + [pi.pod for pi in self.unschedulable_q.values()]
                 + [pi.pod for pi in self._quarantine_held.values()]
                 + [pi.pod for pi in self._quarantine_parked.values()]
+                + [pi.pod for pi in self._quota_parked.values()]
             )
 
     def num_pending(self) -> Dict[str, int]:
@@ -936,4 +1054,7 @@ class PriorityQueue:
             if self._quarantine_seen:
                 counts["quarantined"] = len(self._quarantine_held)
                 counts["quarantine_parked"] = len(self._quarantine_parked)
+            # same refresh-down contract as the quarantine keys
+            if self._quota_seen:
+                counts["quota_parked"] = len(self._quota_parked)
             return counts

@@ -145,6 +145,19 @@ class SchedulerApp:
                 self.sched, self.client, self.config.partition,
                 self.identity,
             )
+        # multi-tenant fairness plane (scheduler/tenancy.py): the
+        # ResourceQuota admission gate + DRF dominant-share bias.
+        # Constructed here so the controller's informer handlers see the
+        # very first watch frames; sync_all + the loop start in start().
+        self.quota_controller = None
+        tn = getattr(self.config, "tenancy", None)
+        if tn is not None and tn.enabled:
+            from kubernetes_tpu.scheduler.tenancy import arm_tenancy
+
+            self.quota_controller = arm_tenancy(
+                self.sched, self.client, self.informers,
+                quota=tn.quota_enforcement, drf_bias=tn.drf_bias,
+            )
         self.reconciler: Optional[ControlPlaneReconciler] = None
         self.recovery_report = None
         self._http: Optional[ThreadingHTTPServer] = None
@@ -188,6 +201,12 @@ class SchedulerApp:
         # rebuilt cache/queue; verify it against apiserver ground truth,
         # adopt anything a previous incarnation bound, and meter it.
         self.recovery_report = recover_on_startup(self.sched, self.client)
+        if self.quota_controller is not None:
+            # rebuild the namespace ledgers from relisted ground truth
+            # (bound pods re-adopt their charges), then run the
+            # event-driven headroom/release loop
+            self.quota_controller.sync_all()
+            self.quota_controller.start()
         # Freeze the synced cluster graph out of cyclic-GC scanning
         # (utils/gc_tuning.py rationale).
         from kubernetes_tpu.utils.gc_tuning import freeze_steady_state_graph
@@ -223,6 +242,8 @@ class SchedulerApp:
             self.sched.start()
 
     def stop(self) -> None:
+        if self.quota_controller is not None:
+            self.quota_controller.stop()
         if self.reconciler is not None:
             self.reconciler.stop()
         if self.coordinator is not None:

@@ -678,8 +678,16 @@ class BatchScheduler(Scheduler):
         # _admission_of.
         profiling = self.profile_stages
         inj = get_injector()
+        quota_gate = self.quota
         for pi in batch_infos:
             if self._skip_pod_schedule(pi.pod):
+                continue
+            if quota_gate is not None and not self._quota_admit(
+                pi, pod_scheduling_cycle
+            ):
+                # parked typed-QuotaExceeded (woken by quota/usage
+                # events) or routed to the backoff clock; either way it
+                # never enters a batch uncharged
                 continue
             if inj is not None:
                 # one POISON_POD draw per pod ever (uid-keyed, so the
@@ -1868,6 +1876,19 @@ class BatchScheduler(Scheduler):
             pad_floor, POD_BUCKET * math.ceil(b / POD_BUCKET)
         )
         order = batch.order
+        # -- tenant fairness bias (scheduler/tenancy.py): within each
+        # priority level, re-merge the solve order so the tenant with
+        # the lowest virtual dominant share places next -- the solve
+        # order IS the arbitration point of the sequential-replay scan,
+        # so every tier (pallas/XLA/mesh/host-greedy) honors the bias
+        # with zero kernel changes. Single-tenant batches exit after
+        # one namespace sweep.
+        tt = self.tenant_shares
+        if tt is not None and b > 1:
+            from kubernetes_tpu.scheduler.tenancy import fair_order
+
+            tt.refresh_capacity(nt)
+            order = fair_order(order, pods, batch.priorities, tt)
         req = np.zeros((padded, nt.dims.num_dims), dtype=np.int32)
         nzr = np.zeros((padded, 2), dtype=np.int32)
         midx = np.zeros(padded, dtype=np.int32)
@@ -2416,6 +2437,23 @@ class BatchScheduler(Scheduler):
                     solver_infos, pod_scheduling_cycle, span,
                     inactive_uids, poisoned=True,
                 )
+            # untyped persistent mesh failure (ROADMAP item 6a): the
+            # FIRST fall for this batch keeps the transient-tolerant
+            # sequential floor below, but an identical batch falling
+            # again is a crash loop -- route it through the containment
+            # disposition (which books exhausted_crashloops and forces
+            # bisection / quarantine) instead of storming the floor on
+            # every retry
+            if self._note_exhaust_sig(solver_infos):
+                logger.warning(
+                    "legacy mesh solve failed repeatedly for the same "
+                    "%d-pod batch; engaging containment",
+                    len(solver_infos),
+                )
+                return self._contain_exhausted_batch(
+                    solver_infos, pod_scheduling_cycle, span,
+                    inactive_uids, poisoned=False,
+                )
             # otherwise: no pallas/host tier distinction -- a failed
             # sharded solve steps straight down to the sequential oracle
             logger.exception("mesh solve failed; sequential fallback")
@@ -2478,6 +2516,23 @@ class BatchScheduler(Scheduler):
 
     # -- blast-radius containment (robustness/containment.py) ----------------
 
+    def _note_exhaust_sig(self, solver_infos: List[PodInfo]) -> bool:
+        """Track the exhausted-batch uid signature; True when the SAME
+        batch has now fallen whole at least twice in a row (a retry
+        storm, not a transient). Shared by the ladder path and the
+        legacy KTPU_MESH_DELTA=0 mesh path (ROADMAP item 6a: an untyped
+        persistent mesh failure used to fall whole to the sequential
+        floor on EVERY retry without ever tripping the detector)."""
+        sig = frozenset(
+            pi.pod.metadata.uid for pi in solver_infos
+        )
+        if sig and sig == self._last_exhaust_sig:
+            self._exhaust_repeats += 1
+        else:
+            self._last_exhaust_sig = sig
+            self._exhaust_repeats = 1
+        return self._exhaust_repeats >= 2
+
     def _contain_exhausted_batch(
         self, solver_infos: List[PodInfo], pod_scheduling_cycle: int,
         span, inactive_uids, poisoned: bool = False,
@@ -2489,15 +2544,7 @@ class BatchScheduler(Scheduler):
         crash-looping singleton goes straight to quarantine, and
         everything else (containment off, gang batches, first-time
         singletons) keeps the sequential-floor fallback."""
-        sig = frozenset(
-            pi.pod.metadata.uid for pi in solver_infos
-        )
-        if sig and sig == self._last_exhaust_sig:
-            self._exhaust_repeats += 1
-        else:
-            self._last_exhaust_sig = sig
-            self._exhaust_repeats = 1
-        crashloop = self._exhaust_repeats >= 2
+        crashloop = self._note_exhaust_sig(solver_infos)
         if crashloop:
             metrics.exhausted_crashloops.inc()
             flightrecorder.mark(
@@ -2732,6 +2779,9 @@ class BatchScheduler(Scheduler):
         """Route one isolated pod through the quarantine ledger and
         surface the event on the pod (Warning event; the PARK
         additionally writes the typed PodQuarantined condition)."""
+        # a quarantined pod holds no capacity: its quota charge (taken
+        # at pop) must not pin the namespace ledger while it sits out
+        self._quota_refund(pi.pod, "quarantine")
         self.pods_quarantined += 1
         disposition = self.quarantine.isolate(pi, reason=reason)
         prof = self.profiles.get(pi.pod.spec.scheduler_name)
@@ -3342,7 +3392,9 @@ class BatchScheduler(Scheduler):
                     # no room (or no feasible node) -- the pod is
                     # re-stamped to a sibling partition and forwarded
                     # through the apiserver, so preemption and backoff
-                    # wait until every partition has had a look
+                    # wait until every partition has had a look (its
+                    # new home stack's quota gate re-charges it there)
+                    self._quota_refund(pi.pod, "spill")
                     self.pods_solved_on_device += 1
                     span.bump("spilled")
                     continue

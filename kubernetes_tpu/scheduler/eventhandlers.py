@@ -64,12 +64,35 @@ def add_all_event_handlers(
     classify_bulk = getattr(sched, "classify_pods_bulk", None)
     attach_counts = getattr(sched, "attach_volume_counts", None)
     bump_volume_gen = getattr(sched, "bump_volume_topology_gen", None)
+    # tenant dominant-share tracker hooks (scheduler/tenancy.py): the
+    # cache-side frames deliver every bound pod exactly once -- our
+    # commits, sibling-stack commits, and the startup relist alike --
+    # so the DRF shares stay honest without a second watch
+    note_bound = getattr(sched, "note_pods_bound", None)
+    note_unbound = getattr(sched, "note_pods_unbound", None)
 
     def _classify_safe(pod: Pod) -> None:
         try:
             classify(pod)
         except Exception:
             logger.exception("classifying pod %s", pod.key())
+
+    def _recovered_quarantined(pod: Pod) -> bool:
+        """A relisted PENDING pod still carrying the persisted
+        PodQuarantined condition (ROADMAP item 6c): it must re-park at
+        ingest, not re-enter batches. Freshly created pods have no
+        conditions, so the fast path is one empty-list check."""
+        conds = pod.status.conditions
+        if not conds:
+            return False
+        from kubernetes_tpu.robustness.containment import (
+            QUARANTINE_CONDITION,
+        )
+
+        return any(
+            c.type == QUARANTINE_CONDITION and c.status == "True"
+            for c in conds
+        )
 
     # scheduled pods -> cache (eventhandlers.go:356)
     def add_pod_to_cache(pod: Pod) -> None:
@@ -79,6 +102,8 @@ def add_all_event_handlers(
             sched.cache.add_pod(pod)
         except Exception:
             logger.exception("add pod %s to cache", pod.key())
+        if note_bound is not None:
+            note_bound([pod])
         # Targeted wake: only parked pods whose affinity terms match the
         # added pod can benefit (eventhandlers.go:90 assignedPodAdded ->
         # scheduling_queue.go:508). During a 10k-burst the cache sees one
@@ -101,10 +126,15 @@ def add_all_event_handlers(
             sched.cache.remove_pod(pod)
         except Exception:
             logger.exception("remove pod %s from cache", pod.key())
+        if note_unbound is not None:
+            note_unbound([pod])
         sched.queue.move_all_to_active_or_backoff_queue(events.AssignedPodDelete)
 
     # unscheduled pods owned by one of our profiles -> queue (:381)
     def add_pod_to_queue(pod: Pod) -> None:
+        if _recovered_quarantined(pod):
+            sched.queue.park_quarantined_recovered(pod)
+            return
         if classify is not None:
             _classify_safe(pod)
         sched.queue.add(pod)
@@ -307,6 +337,8 @@ def add_all_event_handlers(
                     sched.cache.add_pods(payload)
                 except Exception:
                     logger.exception("bulk add pods to cache")
+                if note_bound is not None:
+                    note_bound(payload)
                 sched.queue.assigned_pods_added_many(payload)
             elif kind == "dels":
                 # one bulk cache remove + ONE queue move per run (a
@@ -315,6 +347,8 @@ def add_all_event_handlers(
                     sched.cache.remove_pods(payload)
                 except Exception:
                     logger.exception("bulk remove pods from cache")
+                if note_unbound is not None:
+                    note_unbound(payload)
                 sched.queue.move_all_to_active_or_backoff_queue(
                     events.AssignedPodDelete
                 )
@@ -322,6 +356,20 @@ def add_all_event_handlers(
                 update_pod_in_cache(*payload)
         for kind, payload in queue_runs:
             if kind == "adds":
+                # relisted pods still carrying the persisted
+                # PodQuarantined condition re-park instead of re-entering
+                # batches (conditions are empty on fresh creates, so the
+                # burst path pays one list-truthiness check per pod)
+                if any(p.status.conditions for p in payload):
+                    rest: list = []
+                    for p in payload:
+                        if _recovered_quarantined(p):
+                            sched.queue.park_quarantined_recovered(p)
+                        else:
+                            rest.append(p)
+                    payload = rest
+                    if not payload:
+                        continue
                 # one ingest pass: plain pods stamp their full record in
                 # C (native ingest_stamp), the rest classify per pod
                 if classify_bulk is not None:

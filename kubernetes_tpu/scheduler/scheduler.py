@@ -102,6 +102,16 @@ class Scheduler:
         # pods re-stamped and forwarded to a sibling partition because
         # their feasible nodes all live there
         self.pods_spilled = 0
+        # -- multi-tenant fairness plane (scheduler/tenancy.py) ----------
+        # the ResourceQuota admission gate (controllers/quota.py): when
+        # attached, every popped pod charges its namespace ledger before
+        # entering an attempt; exhausted namespaces park their pods
+        # typed-QuotaExceeded. None = plane off (one is-None check).
+        self.quota = None
+        # the DRF dominant-share tracker: maintained from the bind
+        # echoes (eventhandlers), consumed by the batched solve order
+        self.tenant_shares = None
+        self.quota_denials = 0
 
     # -- profile lookup (scheduler.go:741 profileForPod) --------------------
 
@@ -154,6 +164,10 @@ class Scheduler:
         reference pays its 1s initial backoff here, scheduling_queue.go
         :643, purely because its preemption is asynchronous)."""
         pod = pod_info.pod
+        # a requeued pod releases its in-flight quota charge (it
+        # re-charges at its next pop): ``used`` stays bound + in-flight,
+        # and the refund's headroom event may wake quota-parked peers
+        self._quota_refund(pod, "requeue")
         prof.recorder.eventf(
             pod, "Warning", "FailedScheduling", err_msg
         )  # scheduler.go:378
@@ -186,6 +200,72 @@ class Scheduler:
                 )
             except Exception:
                 logger.exception("updating pod condition for %s", pod.key())
+
+    # -- multi-tenant quota gate (controllers/quota.py) ----------------------
+
+    def _quota_refund(self, pod: Pod, reason: str) -> None:
+        """Give back the pod's quota charge (no-op when the plane is
+        off or the pod holds none); never raises -- a failed refund is
+        parked on the controller's retry list, not lost."""
+        qc = self.quota
+        if qc is None:
+            return
+        try:
+            qc.refund(pod, reason=reason)
+        except Exception:
+            logger.exception("quota refund for %s", pod.key())
+
+    def _quota_admit(self, pod_info, pod_scheduling_cycle: int) -> bool:
+        """The hard-quota admission gate, run once per popped pod when
+        the plane is armed (callers check ``self.quota`` first, so the
+        off state costs one is-None read). Granted pods proceed
+        charged; exhausted namespaces park the pod typed-QuotaExceeded
+        (released by quota/usage EVENTS, never polled). A transport
+        failure fails CLOSED onto the backoff clock -- parking without
+        a wake event would strand the pod."""
+        qc = self.quota
+        pod = pod_info.pod
+        try:
+            denial = qc.try_admit(pod)
+        except Exception:  # noqa: BLE001 - injected api_unavailable etc.
+            logger.exception("quota admission for %s", pod.key())
+            prof = self.profiles.get(pod.spec.scheduler_name)
+            if prof is not None:
+                self.record_scheduling_failure(
+                    prof, pod_info,
+                    "quota admission check unavailable; retrying",
+                    "QuotaError", "", pod_scheduling_cycle,
+                )
+            return False
+        if not denial:
+            return True
+        self.quota_denials += 1
+        self.queue.park_quota_exceeded(pod_info)
+        qc.note_parked(pod, denial)
+        prof = self.profiles.get(pod.spec.scheduler_name)
+        if prof is not None:
+            try:
+                prof.recorder.eventf(
+                    pod, "Warning", "FailedScheduling", denial
+                )
+            except Exception:  # noqa: BLE001 - events are best-effort
+                pass
+        return False
+
+    # -- tenant dominant-share bookkeeping (scheduler/tenancy.py) ------------
+
+    def note_pods_bound(self, pods: List[Pod]) -> None:
+        """Bind echoes from the informer frames: the DRF tracker's
+        incremental ``used`` update (covers our commits, sibling-stack
+        commits, and the startup relist alike)."""
+        tt = self.tenant_shares
+        if tt is not None:
+            tt.note_bound(pods)
+
+    def note_pods_unbound(self, pods: List[Pod]) -> None:
+        tt = self.tenant_shares
+        if tt is not None:
+            tt.note_unbound(pods)
 
     # -- assume (scheduler.go:474) ------------------------------------------
 
@@ -287,6 +367,15 @@ class Scheduler:
         pod_info = self.queue.pop(timeout=timeout)
         if pod_info is None:
             return False
+        # skip-worthy pods (deleting / assumed / re-homed) must not
+        # charge quota: attempt_schedule would drop them without a
+        # failure path, so a charge here would never refund
+        if self.quota is not None and not self._skip_pod_schedule(
+            pod_info.pod
+        ) and not self._quota_admit(
+            pod_info, self.queue.scheduling_cycle
+        ):
+            return True  # parked typed-QuotaExceeded (or backoff-retried)
         self.attempt_schedule(pod_info)
         return True
 
@@ -307,6 +396,8 @@ class Scheduler:
         pod = pod_info.pod
         coord = self.partition_coordinator
         if coord is not None and coord.try_spill(pod):
+            # re-homed to a sibling partition: ITS gate re-charges there
+            self._quota_refund(pod, "spill")
             return
         nominated_node = ""
         if self.preemptor is not None:

@@ -616,6 +616,39 @@ quarantine_releases = registry.register(Counter(
     "Held pods released back to the activeQ after their quarantine "
     "hold expired (bounded retries before parking).",
 ))
+quota_admissions = registry.register(Counter(
+    "scheduler_quota_admissions_total",
+    "ResourceQuota decisions at the scheduling gate: granted charges "
+    "the namespace ledger (guaranteed_update check-and-increment); "
+    "denied parks the pod typed-QuotaExceeded until a quota or usage "
+    "event frees headroom.",
+    ("result",),
+))
+quota_refunds = registry.register(Counter(
+    "scheduler_quota_refunds_total",
+    "Quota charges given back (exactly once per pod incarnation), by "
+    "reason: requeue (scheduling/bind failure), spill (re-homed to a "
+    "sibling partition), quarantine, delete.",
+    ("reason",),
+))
+quota_parked = registry.register(Gauge(
+    "scheduler_quota_parked",
+    "Pods currently parked typed-QuotaExceeded (released by quota/"
+    "usage events only, never polled).",
+))
+quota_releases = registry.register(Counter(
+    "scheduler_quota_releases_total",
+    "Quota-parked pods released back to the activeQ after a quota "
+    "raise or a usage drop opened headroom for them.",
+))
+tenant_dominant_share = registry.register(Gauge(
+    "scheduler_tenant_dominant_share",
+    "DRF dominant share (max over cpu/memory of tenant-used / "
+    "cluster-capacity) across tenants with usage, by stat: max = the "
+    "most-served tenant; spread = max - min (the fairness gap the "
+    "solve-order bias closes).",
+    ("stat",),
+))
 carry_audit_sweeps = registry.register(Counter(
     "scheduler_tpu_carry_audit_sweeps_total",
     "Carry integrity audits run (cheap on-device checksum of the "

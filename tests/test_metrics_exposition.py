@@ -188,6 +188,15 @@ class TestMetricsEndpointE2E:
         assert "scheduler_tpu_carry_audit_mismatches_total" in body
         assert "scheduler_tpu_device_lost_total" in body
         assert "scheduler_tpu_device_rebuild_ms" in body
+        # multi-tenant fairness families (ISSUE 15): the quota ledger
+        # counters and the DRF dominant-share gauge ride the default
+        # registry so a starving tenant or a leaking ledger alerts from
+        # the first scrape
+        assert "scheduler_quota_admissions_total" in body
+        assert "scheduler_quota_refunds_total" in body
+        assert "scheduler_quota_parked" in body
+        assert "scheduler_quota_releases_total" in body
+        assert "scheduler_tenant_dominant_share" in body
         # and the quantile gauge carries a real estimate post-burst
         p99 = metrics.pod_to_bind_quantile.value(q="0.99")
         assert p99 > 0.0

@@ -900,6 +900,89 @@ def bench_bisect(burst: int, num_nodes: int = 64):
     }
 
 
+def bench_tenant_columns(num_ns: int = 1000, num_pods: int = 5000):
+    """ISSUE 15 hot-path costs of the multi-tenant fairness plane at
+    1k namespaces / 5k pods: the quota ledger's charge+refund round
+    trip (guaranteed_update check-and-increment per pod), the DRF
+    tracker's incremental share update + dominant-share read, and the
+    fair solve-order merge on a max_batch-sized multi-tenant batch
+    (the per-dispatch cost the <5% single-tenant headline bounds)."""
+    from kubernetes_tpu.api.types import ObjectMeta, ResourceQuota
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.client.client import Client
+    from kubernetes_tpu.client.informer import InformerFactory
+    from kubernetes_tpu.controllers.quota import QuotaController
+    from kubernetes_tpu.scheduler.tenancy import (
+        TenantShareTracker,
+        fair_order,
+    )
+    from kubernetes_tpu.testing import make_pod
+
+    server = APIServer()
+    client = Client(server)
+    informers = InformerFactory(server)
+    qc = QuotaController(client, informers)
+    for t in range(num_ns):
+        client.create_resource_quota(ResourceQuota(
+            metadata=ObjectMeta(name="quota", namespace=f"tenant-{t}"),
+            hard={"pods": num_pods, "cpu": 1 << 30},
+        ))
+    pods = []
+    for i in range(num_pods):
+        p = make_pod(f"tq-{i}").container(cpu="250m", memory="512Mi").obj()
+        p.metadata.namespace = f"tenant-{i % num_ns}"
+        pods.append(p)
+    client.create_pods_bulk(pods)
+    informers.pump()  # the gate's liveness re-read needs the lister
+
+    # charge every pod (one guaranteed_update per pod), then refund all
+    t0 = time.perf_counter()
+    for p in pods:
+        qc.try_admit(p)
+    charge_ms = (time.perf_counter() - t0) * 1000
+    t0 = time.perf_counter()
+    for p in pods:
+        qc.refund(p, reason="requeue")
+    refund_ms = (time.perf_counter() - t0) * 1000
+
+    # DRF tracker: incremental usage update + per-namespace share reads
+    tracker = TenantShareTracker()
+    tracker.set_capacity(32000 * 5000, (64 << 30) // 1024 * 5000)
+    t0 = time.perf_counter()
+    tracker.note_bound(pods)
+    note_ms = (time.perf_counter() - t0) * 1000
+    t0 = time.perf_counter()
+    shares = tracker.shares_for({p.metadata.namespace for p in pods})
+    share_ms = (time.perf_counter() - t0) * 1000
+    assert len(shares) == num_ns
+
+    # fair solve-order merge on a 1024-pod multi-tenant batch (and the
+    # single-tenant fast path next to it -- the steady-state cost)
+    batch = pods[:1024]
+    prio = np.asarray([p.spec.priority for p in batch], dtype=np.int32)
+    base = np.arange(len(batch), dtype=np.int32)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        fair_order(base, batch, prio, tracker)
+    fair_ms = (time.perf_counter() - t0) * 1000 / 10
+    single = [make_pod(f"st-{i}").container(cpu="100m").obj()
+              for i in range(1024)]
+    sprio = np.zeros(1024, dtype=np.int32)
+    t0 = time.perf_counter()
+    for _ in range(50):
+        fair_order(base, single, sprio, tracker)
+    fair_single_ms = (time.perf_counter() - t0) * 1000 / 50
+    return {
+        "tenant_charge_ms": charge_ms,
+        "tenant_charge_perpod_us": charge_ms * 1000 / num_pods,
+        "tenant_refund_ms": refund_ms,
+        "tenant_note_bound_ms": note_ms,
+        "tenant_share_read_ms": share_ms,
+        "tenant_fair_order_1024_ms": fair_ms,
+        "tenant_fair_order_single_ns_ms": fair_single_ms,
+    }
+
+
 def bench_watch_fanout(events: int = 20000):
     """Apiserver watch fan-out under N consumers (the partitioned
     control plane runs one full informer set PER STACK): broadcast
@@ -1324,6 +1407,7 @@ def main() -> None:
     mesh_pallas = bench_mesh_pallas(args.mesh_nodes, args.mesh_devices)
     preempt = bench_preemption_wave(args.nodes)
     fanout = bench_watch_fanout()
+    tenant = bench_tenant_columns()
     ingest = bench_ingest()
     trace_overhead = bench_trace_overhead()
     bisect = {}
@@ -1374,6 +1458,7 @@ def main() -> None:
         }
     )
     record.update({k: round(v, 2) for k, v in fanout.items()})
+    record.update({k: round(v, 3) for k, v in tenant.items()})
     record.update(
         {
             k: (v if isinstance(v, (int, bool)) else round(v, 3))
