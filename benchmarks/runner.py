@@ -40,6 +40,7 @@ if _force_devs and "xla_force_host_platform_device_count" not in os.environ.get(
 
 from kubernetes_tpu.api.types import (
     POD_GROUP_LABEL,
+    POD_RUNNING,
     ObjectMeta,
     PodGroup,
     Service,
@@ -668,6 +669,18 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         devs = jax.devices()
         mesh_devices = min(mesh_devices, len(devs))
         mesh = Mesh(_np.array(devs[:mesh_devices]), axis_names=("nodes",))
+    # `fleet:` closes the bind loop (ISSUE 17): a sharded
+    # HollowNodeFleet acks every bind into Running, the scheduler's
+    # BindAckTracker treats a bind as pending until that ack lands (and
+    # rebinds on timeout), and the row's success gate becomes
+    # pods RUNNING, not pods bound
+    fleet_cfg = wl.get("fleet")
+    bind_ack_config = None
+    if fleet_cfg is not None and fleet_cfg.get("bind_ack") is not False:
+        from kubernetes_tpu.config.types import BindAckConfiguration
+
+        ba = dict(fleet_cfg.get("bind_ack") or {})
+        bind_ack_config = BindAckConfiguration(enabled=True, **ba)
     sched = new_scheduler(
         client,
         informers,
@@ -676,6 +689,7 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         solver_config=solver_cfg,
         solver_mode=wl.get("solver_mode", "greedy"),
         mesh=mesh,
+        bind_ack_config=bind_ack_config,
     )
 
     # workload-scoped open-loop streaming (kubernetes_tpu/streaming/):
@@ -943,6 +957,63 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         )
         hollow.start()
 
+    fleet = None
+    fleet_lifecycle = None
+    fleet_disruption = None
+    fleet_respawner = None
+    zombie_nodes: List[str] = []
+    if fleet_cfg is not None:
+        from kubernetes_tpu.kubelet import FleetConfig, HollowNodeFleet
+
+        _fc_keys = (
+            "shard_size", "ack_latency_seconds", "ack_latency_jitter",
+            "heartbeat_interval_seconds", "lease_duration_seconds",
+            "allocatable_drift", "seed",
+        )
+        fleet = HollowNodeFleet(
+            client,
+            [f"node-{i}" for i in range(num_nodes)],
+            FleetConfig(**{
+                k: fleet_cfg[k] for k in _fc_keys if k in fleet_cfg
+            }),
+        )
+        n_zombie = int(fleet_cfg.get("zombies", 0))
+        if n_zombie:
+            # zombie kubelets: lease renews forever, acks never come --
+            # only the bind-ack timeout can route around them
+            zombie_nodes = [f"node-{i}" for i in range(n_zombie)]
+            fleet.mark_zombie(zombie_nodes)
+        fleet.start()
+        lc = fleet_cfg.get("lifecycle")
+        if lc:
+            from kubernetes_tpu.controllers import DisruptionController
+            from kubernetes_tpu.controllers.nodelifecycle import (
+                NodeLifecycleController,
+            )
+
+            fleet_disruption = DisruptionController(client, informers)
+            fleet_disruption.start()
+            fleet_lifecycle = NodeLifecycleController(
+                client, informers,
+                grace_period=float(lc.get("grace_period", 40.0)),
+                monitor_interval=float(lc.get("monitor_interval", 5.0)),
+                disruption=fleet_disruption,
+            )
+            fleet_lifecycle.start()
+        if fleet_cfg.get("respawn_evicted"):
+            # heartbeat-lapse evictions DELETE pods; the respawner
+            # feeds each one back as a fresh pending arrival so the
+            # closed loop must land it somewhere alive
+            from kubernetes_tpu.robustness.lifecycle import PodRespawner
+
+            fleet_respawner = PodRespawner(
+                client,
+                should_respawn=(
+                    lambda p: p.metadata.name.startswith("measure-")
+                ),
+            )
+            fleet_respawner.start()
+
     coll = None
     engine = None
     try:
@@ -1122,6 +1193,30 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
                 name="quota-scenario", daemon=True,
             )
             quota_thread.start()
+        fleet_storm = (fleet_cfg or {}).get("dark")
+        fleet_dark_state = None
+        if fleet_storm and fleet is not None:
+            # heartbeat-lapse storm: N hollow agents go fully dark
+            # mid-burst (no acks, no lease renewals); the nodelifecycle
+            # monitor must notice the lapsed leases, taint NoExecute,
+            # and evict through the shared disruption budget
+            fleet_dark_state = {"fired": False, "nodes": []}
+
+            def _run_fleet_storm(coll_ref, _fleet=fleet,
+                                 _skip=len(zombie_nodes),
+                                 _state=fleet_dark_state):
+                frac = float(fleet_storm.get("at_fraction", 0.5))
+                _wait_fraction_bound(coll_ref, frac, timeout_s)
+                count = int(fleet_storm.get("count", 0))
+                dark = [f"node-{i}" for i in range(_skip, _skip + count)]
+                _state["nodes"] = dark
+                _fleet.go_dark(dark)
+                _state["fired"] = True
+
+            threading.Thread(
+                target=_run_fleet_storm, args=(coll,),
+                name="fleet-storm", daemon=True,
+            ).start()
         ok = True
         streaming_rec: Dict[str, Any] = {}
         if streaming:
@@ -1274,6 +1369,56 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
                 lifecycle_counters["pods_respawned"] = rsp.respawned
             lifecycle_counters["settled"] = settled
             ok = ok and settled
+
+        pods_running = 0
+        if fleet is not None:
+            # the closed-loop settle: a bind only COUNTS once the hollow
+            # kubelet acked it into Running. Ack-timeout rebinds and
+            # respawned evictees keep landing after the last first-bind,
+            # so the Running census converges later than the collector.
+            need_running = int(
+                float(wl.get("min_bound_fraction", 1.0))
+                * len(target_names)
+            )
+
+            def _count_running():
+                return sum(
+                    1 for p in client.list_pods()[0]
+                    if p.metadata.name.startswith("measure-")
+                    and p.status.phase == POD_RUNNING
+                    and p.metadata.deletion_timestamp is None
+                )
+
+            def _running_on_dark():
+                # a dark-storm row only settles once the eviction loop
+                # has actually run: the storm fired AND no surviving
+                # Running pod still rests on a dark node
+                if fleet_dark_state is None:
+                    return 0
+                dark = set(fleet_dark_state["nodes"])
+                return sum(
+                    1 for p in client.list_pods()[0]
+                    if p.metadata.name.startswith("measure-")
+                    and p.status.phase == POD_RUNNING
+                    and p.metadata.deletion_timestamp is None
+                    and p.spec.node_name in dark
+                )
+
+            def _settled():
+                if pods_running < need_running:
+                    return False
+                if fleet_dark_state is not None and (
+                    not fleet_dark_state["fired"]
+                    or _running_on_dark() > 0
+                ):
+                    return False
+                return True
+
+            settle_deadline = time.time() + min(timeout_s, 300.0)
+            pods_running = _count_running()
+            while time.time() < settle_deadline and not _settled():
+                time.sleep(0.25)
+                pods_running = _count_running()
 
         bound = sum(1 for n in target_names if n in coll.bind_times)
         # capacity-starved workloads (GangContention) EXPECT a fraction
@@ -1539,6 +1684,56 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
                 )
                 trec["high_priority_unbound"] = unbound_high
                 result["ok"] = bool(result["ok"]) and unbound_high == 0
+        if fleet is not None:
+            # closed-loop labels + the Running gate: the row fails
+            # unless the needed fraction of measured pods is RUNNING
+            # (not merely bound), none of them sits on a zombie, and
+            # the ack/rebind/eviction ledgers ride along for the
+            # dashboard
+            tracker = sched.bind_ack_tracker
+            frec: Dict[str, Any] = {
+                "pods_running": pods_running,
+                "pods_acked": fleet.pods_acked,
+                "heartbeats": fleet.heartbeats_sent,
+                "heartbeat_lapses": fleet.heartbeat_lapses,
+                "stale_acks": fleet.stale_acks,
+                "acks_suppressed": fleet.acks_suppressed,
+            }
+            if tracker is not None:
+                frec.update(
+                    acks=tracker.acks,
+                    acks_late=tracker.acks_late,
+                    ack_timeouts=tracker.timeouts,
+                    rebinds=tracker.rebinds,
+                    ack_pending=tracker.pending_count(),
+                )
+            if fleet_lifecycle is not None:
+                frec.update(
+                    evictions=fleet_lifecycle.evictions,
+                    evictions_blocked=fleet_lifecycle.evictions_blocked,
+                )
+            if fleet_respawner is not None:
+                frec["pods_respawned"] = fleet_respawner.respawned
+            if zombie_nodes:
+                zset = set(zombie_nodes)
+                on_zombie = sum(
+                    1 for p in client.list_pods()[0]
+                    if p.spec.node_name in zset
+                    and p.metadata.deletion_timestamp is None
+                )
+                frec["pods_on_zombies"] = on_zombie
+                result["ok"] = bool(result["ok"]) and on_zombie == 0
+            if fleet_dark_state is not None:
+                on_dark = _running_on_dark()
+                frec["storm_fired"] = bool(fleet_dark_state["fired"])
+                frec["pods_on_dark"] = on_dark
+                result["ok"] = bool(
+                    result["ok"]
+                    and fleet_dark_state["fired"]
+                    and on_dark == 0
+                )
+            result["fleet"] = frec
+            result["ok"] = bool(result["ok"]) and pods_running >= need
         if lifecycle_counters:
             result["lifecycle"] = lifecycle_counters
         if streaming_rec:
@@ -1584,6 +1779,13 @@ def run_workload(wl: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]
         sched.stop()
         if hollow is not None:
             hollow.stop()
+        for comp in (fleet_respawner, fleet_lifecycle,
+                     fleet_disruption, fleet):
+            if comp is not None:
+                try:
+                    comp.stop()
+                except Exception:  # noqa: BLE001 - teardown keeps going
+                    pass
         informers.stop()
 
 
@@ -1629,6 +1831,12 @@ def to_data_items(results: List[Dict[str, Any]]) -> Dict[str, Any]:
             {
                 f"tenant_{k}": str(v)
                 for k, v in (r.get("tenant") or {}).items()
+            }
+        )
+        labels.update(
+            {
+                f"fleet_{k}": str(v)
+                for k, v in (r.get("fleet") or {}).items()
             }
         )
         if r.get("error") or not r.get("ok", False):
@@ -1705,6 +1913,35 @@ def main(argv: Optional[List[str]] = None) -> int:
         r["wall_s"] = round(time.perf_counter() - t0, 1)
         print(json.dumps(r), file=sys.stderr, flush=True)
         results.append(r)
+
+    # cross-row throughput floors (`throughput_floor: {of: <row>,
+    # fraction: F}`): the closed-loop BigClusterBasic row must keep
+    # >= F of its bind-and-forget sibling's throughput -- the ack spine
+    # may not eat the pipeline. Evaluated after the matrix so the
+    # reference row's number exists; a missing/failed reference row
+    # skips the floor rather than inventing one.
+    by_name = {r["name"]: r for r in results}
+    for wl in cfg.get("workloads") or []:
+        floor = wl.get("throughput_floor")
+        if not floor or wl["name"] not in by_name:
+            continue
+        row = by_name[wl["name"]]
+        ref = by_name.get(floor.get("of", ""))
+        if ref is None or not ref.get("ok"):
+            continue
+        frac = float(floor.get("fraction", 0.8))
+        ref_thr = float(ref.get("throughput_pods_per_s", 0.0))
+        row_thr = float(row.get("throughput_pods_per_s", 0.0))
+        row["throughput_floor"] = {
+            "of": floor.get("of"), "fraction": frac,
+            "reference_pods_per_s": ref_thr,
+        }
+        if ref_thr > 0 and row_thr < frac * ref_thr:
+            row["ok"] = False
+            row["error"] = (
+                f"closed-loop throughput {row_thr} < {frac} x "
+                f"{ref_thr} ({floor.get('of')})"
+            )
 
     out = to_data_items(results)
     with open(args.out, "w") as f:

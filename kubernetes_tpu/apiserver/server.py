@@ -24,7 +24,7 @@ from bisect import bisect_right
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from kubernetes_tpu.api.types import Binding, Node, Pod
+from kubernetes_tpu.api.types import POD_PENDING, POD_RUNNING, Binding, Node, Pod
 from kubernetes_tpu.robustness.faults import FaultPoint, get_injector
 
 try:
@@ -213,6 +213,91 @@ def _obj_key(obj: Any) -> Tuple[str, str]:
     return (meta.namespace, meta.name)
 
 
+def _route_key(kind: str, obj: Any) -> str:
+    """The per-host routing key of an event: which single consumer (if
+    any) a routed watcher set would want it delivered to. Pods route by
+    the node they are bound to (a kubelet's spec.nodeName-filtered
+    watch); everything else routes by object name (node-lease renewals
+    and NodeStatus writes route to that node's watcher)."""
+    if kind == "Pod":
+        return obj.spec.node_name or ""
+    return obj.metadata.name
+
+
+class RoutedWatch:
+    """A route-filtered watch cursor with a PRIVATE buffer.
+
+    Unlike ``Watch`` (a cursor into the kind's shared log, where every
+    watcher drains every event), a RoutedWatch registers the route keys
+    it wants (node names) and the broadcast path delivers each event to
+    the interested watchers ONLY -- one dict probe per event, zero work
+    per uninterested watcher. This is what keeps fleet-scale heartbeat
+    traffic O(interested) instead of O(watchers): ten thousand hollow
+    kubelets sharing a kind do not each rescan every sibling's Lease
+    renewals (tools/bench_hotpath.py ``heartbeat_fanout_*`` pins this).
+
+    Events that never had a route (an unbound pod) are invisible here by
+    design -- a kubelet only cares once spec.nodeName points at it. A
+    consumer that stalls past the server's history limit overflows its
+    buffer and gets ``Gone`` on the next read (relist, same 410 contract
+    as a lagged shared-log cursor).
+    """
+
+    __slots__ = ("_server", "kind", "routes", "_events", "_overflowed",
+                 "stopped")
+
+    def __init__(self, server: "APIServer", kind: str, routes) -> None:
+        self._server = server
+        self.kind = kind
+        self.routes = frozenset(routes)
+        self._events: List[WatchEvent] = []
+        self._overflowed = False
+        self.stopped = False
+
+    def _deliver_locked(self, ev: WatchEvent) -> None:
+        """Caller holds the kind condition (the broadcast path)."""
+        if self._overflowed:
+            return
+        if len(self._events) >= self._server._history_limit:
+            self._overflowed = True
+            self._events = []
+            return
+        self._events.append(ev)
+
+    def _drain_locked(self) -> List[WatchEvent]:
+        if self._overflowed:
+            self._overflowed = False
+            raise Gone(
+                f"{self.kind} routed watch overflowed its buffer; relist"
+            )
+        out = self._events
+        self._events = []
+        return out
+
+    def next_batch(
+        self, timeout: Optional[float] = None
+    ) -> List[WatchEvent]:
+        cond = self._server._kind_conds[self.kind]
+        with cond:
+            if not self._events and not self._overflowed \
+                    and not self.stopped:
+                cond.wait(timeout)
+            return self._drain_locked()
+
+    def pending(self) -> List[WatchEvent]:
+        cond = self._server._kind_conds[self.kind]
+        with cond:
+            return self._drain_locked()
+
+    def stop(self) -> None:
+        self._server._remove_watch(self)
+        cond = self._server._kind_conds.get(self.kind)
+        self.stopped = True
+        if cond is not None:
+            with cond:
+                cond.notify_all()
+
+
 class APIServer:
     """Multi-kind object store with watch fan-out."""
 
@@ -246,6 +331,11 @@ class APIServer:
         # highest rv ever trimmed out of a kind's history: a watch asking
         # to replay from below this would silently miss events -> Gone
         self._history_trunc_rv: Dict[str, int] = {k: 0 for k in self.KINDS}
+        # per-host routed delivery: kind -> route key -> interested
+        # RoutedWatch list (guarded by the kind condition). Empty unless
+        # someone opened a routed watch, so the broadcast fast path pays
+        # one falsy dict probe per transaction.
+        self._route_watchers: Dict[str, Dict[str, List[RoutedWatch]]] = {}
         # multi-active partitioned scheduling (scheduler/partition.py):
         # when installed, bulk binds carrying a binder identity are
         # checked against the live partition leases under the store lock
@@ -283,12 +373,28 @@ class APIServer:
             self._history_base[kind] += cut
             del hist[:cut]
 
+    def _route_locked(self, kind: str, event: WatchEvent) -> None:
+        """Deliver one event to the routed watchers interested in its
+        route key (caller holds the kind condition). One dict probe per
+        event when the routing index is armed; nothing otherwise."""
+        idx = self._route_watchers.get(kind)
+        if not idx:
+            return
+        route = _route_key(kind, event.object)
+        if not route:
+            return
+        watchers = idx.get(route)
+        if watchers:
+            for w in watchers:
+                w._deliver_locked(event)
+
     def _broadcast(self, kind: str, event: WatchEvent) -> None:
         cond = self._kind_conds[kind]
         with cond:
             hist = self._history[kind]
             hist.append(event)
             self._trim_history_locked(kind, hist)
+            self._route_locked(kind, event)
             cond.notify_all()
 
     def _broadcast_many(self, kind: str, events: List[WatchEvent]) -> None:
@@ -303,6 +409,9 @@ class APIServer:
             hist = self._history[kind]
             hist.extend(events)
             self._trim_history_locked(kind, hist)
+            if self._route_watchers.get(kind):
+                for ev in events:
+                    self._route_locked(kind, ev)
             cond.notify_all()
 
     def current_rv(self) -> int:
@@ -505,8 +614,57 @@ class APIServer:
                 cursor = self._history_base[kind] + idx
             return Watch(self, kind, cursor)
 
-    def _remove_watch(self, w: Watch) -> None:
-        pass  # cursors hold no server-side state to unregister
+    def watch_routes(
+        self, kind: str, routes, since_rv: int = 0
+    ) -> RoutedWatch:
+        """Open a route-filtered watch: only events whose route key
+        (Pod -> spec.nodeName, else metadata.name) is in ``routes`` are
+        delivered. Retained history after ``since_rv`` is replayed
+        (filtered) into the buffer at registration, so the list+watch
+        handshake works exactly like the shared-log cursor; a since_rv
+        below the trim raises Gone."""
+        with self._lock:
+            self._ensure_kind(kind)
+            if since_rv < self._history_trunc_rv.get(kind, 0):
+                raise Gone(
+                    f"{kind} watch history truncated past rv "
+                    f"{self._history_trunc_rv[kind]}; cannot replay from "
+                    f"{since_rv}"
+                )
+            cond = self._kind_conds[kind]
+            with cond:
+                w = RoutedWatch(self, kind, routes)
+                hist = self._history[kind]
+                rvs = [ev.resource_version for ev in hist]
+                idx = bisect_right(rvs, since_rv)
+                for ev in hist[idx:]:
+                    if _route_key(kind, ev.object) in w.routes:
+                        w._deliver_locked(ev)
+                index = self._route_watchers.setdefault(kind, {})
+                for route in w.routes:
+                    index.setdefault(route, []).append(w)
+            return w
+
+    def _remove_watch(self, w) -> None:
+        # shared-log cursors hold no server-side state; routed watchers
+        # unregister from the delivery index
+        if not isinstance(w, RoutedWatch):
+            return
+        cond = self._kind_conds.get(w.kind)
+        if cond is None:
+            return
+        with cond:
+            index = self._route_watchers.get(w.kind)
+            if not index:
+                return
+            for route in w.routes:
+                watchers = index.get(route)
+                if watchers and w in watchers:
+                    watchers.remove(w)
+                    if not watchers:
+                        del index[route]
+            if not index:
+                self._route_watchers.pop(w.kind, None)
 
     # -- pods/binding subresource (storage.go:159 BindingREST.Create) -------
 
@@ -583,6 +741,82 @@ class APIServer:
                     "Pod",
                     WatchEvent(MODIFIED, pod, pod.metadata.resource_version),
                 )
+            return pod
+
+    def unbind(
+        self, namespace: str, name: str,
+        expect_uid: Optional[str] = None,
+        expect_node: Optional[str] = None,
+    ) -> Pod:
+        """Atomically release a binding: clear spec.nodeName, reset the
+        phase to Pending, drop start_time. The rebind-after-timeout
+        primitive of the closed bind loop -- a bound-but-never-acked pod
+        goes back to unbound UNDER THE STORE LOCK, fenced three ways:
+
+        - ``expect_uid``: the incarnation the ack deadline was armed for
+          (a respawn under the same key must not be unbound);
+        - ``expect_node``: the node the bind targeted (a racing rebind
+          that already moved the pod must not be undone);
+        - the pod must not be ``Running`` yet: a kubelet ack that lands
+          first WINS and the unbind comes back as a typed ``acked``
+          conflict (the tracker treats that as the ack it was waiting
+          for). The store lock is the serialization point, so exactly
+          one of {ack, unbind} takes effect.
+
+        The MODIFIED bound->unbound event re-enters the pod into the
+        scheduling queue and releases the zombie node's capacity through
+        the ordinary cache-removal/slot-scatter path -- no scheduler
+        side channel."""
+        _api_unavailable_maybe()
+        with self._lock:
+            store = self._stores["Pod"]
+            old: Optional[Pod] = store.get((namespace, name))
+            if old is None:
+                raise NotFound(f"Pod {namespace}/{name} not found")
+            if expect_uid is not None and old.metadata.uid != expect_uid:
+                raise BindConflict(
+                    f"pod {old.key()} uid mismatch: unbind targeted "
+                    f"{expect_uid}, pod has {old.metadata.uid}",
+                    kind="uid-mismatch",
+                )
+            if not old.spec.node_name:
+                return old  # already unbound: idempotent success
+            if (
+                expect_node is not None
+                and old.spec.node_name != expect_node
+            ):
+                raise BindConflict(
+                    f"pod {old.key()} is bound to {old.spec.node_name}, "
+                    f"not {expect_node}",
+                    kind="already-bound",
+                    current_node=old.spec.node_name,
+                )
+            if old.status.phase == POD_RUNNING:
+                raise BindConflict(
+                    f"pod {old.key()} was acked Running on "
+                    f"{old.spec.node_name}; binding stands",
+                    kind="acked",
+                    current_node=old.spec.node_name,
+                )
+            if _cow_clone is not None:
+                pod = _cow_clone(old, _POD_COW_ATTRS)
+            else:
+                import copy as _copy
+
+                pod = _copy.copy(old)
+                pod.metadata = _copy.copy(old.metadata)
+                pod.spec = _copy.copy(old.spec)
+                pod.status = _copy.copy(old.status)
+            pod.spec.node_name = ""
+            pod.status.phase = POD_PENDING
+            pod.status.start_time = None
+            _strip_memos(pod)
+            pod.metadata.resource_version = self._next_rv()
+            store[(namespace, name)] = pod
+            self._broadcast(
+                "Pod",
+                WatchEvent(MODIFIED, pod, pod.metadata.resource_version),
+            )
             return pod
 
     def bind_bulk(

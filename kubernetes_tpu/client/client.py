@@ -71,6 +71,18 @@ class Client:
     ) -> Pod:
         return self._server.update_pod_status(namespace, name, mutate)
 
+    def unbind_pod(
+        self, namespace: str, name: str,
+        expect_uid: Optional[str] = None,
+        expect_node: Optional[str] = None,
+    ) -> Pod:
+        """Release a binding (DELETE pods/<name>/binding analogue):
+        uid/node/not-yet-Running preconditions checked atomically under
+        the store lock -- the rebind-after-timeout primitive."""
+        return self._server.unbind(
+            namespace, name, expect_uid=expect_uid, expect_node=expect_node
+        )
+
     # nodes
     def create_node(self, node: Node) -> Node:
         return self._server.create(node)

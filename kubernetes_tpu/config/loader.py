@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional
 import yaml
 
 from kubernetes_tpu.config.types import (
+    BindAckConfiguration,
     ContainmentConfiguration,
     FaultInjectionConfiguration,
     FaultPointConfiguration,
@@ -256,6 +257,18 @@ def load_config_from_dict(raw: Dict[str, Any]) -> KubeSchedulerConfiguration:
         enabled=bool(tn_raw.get("enabled", False)),
         quota_enforcement=bool(tn_raw.get("quotaEnforcement", True)),
         drf_bias=bool(tn_raw.get("drfBias", True)),
+    )
+    ba_raw = raw.get("bindAck", {})
+    cfg.bind_ack = BindAckConfiguration(
+        enabled=bool(ba_raw.get("enabled", False)),
+        ack_timeout_seconds=_duration_seconds(
+            ba_raw.get("ackTimeout", 5.0)
+        ),
+        sweep_interval_seconds=_duration_seconds(
+            ba_raw.get("sweepInterval", 0.5)
+        ),
+        node_suspect_threshold=int(ba_raw.get("nodeSuspectThreshold", 1)),
+        taint_suspect_nodes=bool(ba_raw.get("taintSuspectNodes", True)),
     )
     fi_raw = raw.get("faultInjection", {})
     cfg.fault_injection = FaultInjectionConfiguration(

@@ -250,6 +250,26 @@ class TenancyConfiguration:
 
 
 @dataclass
+class BindAckConfiguration:
+    """Bind-ack tracking (scheduler/bindack.py): a bind is pending until
+    the node agent acks it into pod status (phase=Running); a pod whose
+    ack never arrives within ``ack_timeout_seconds`` is unbound back to
+    the queue and rebinds elsewhere -- exactly once per incarnation.
+    Off by default: bind-and-forget deployments pay one is-None check
+    per commit. The ack timeout should sit well under the nodelifecycle
+    grace period: a zombie kubelet heartbeats forever, so the ack path
+    must fire first."""
+
+    enabled: bool = False
+    ack_timeout_seconds: float = 5.0
+    sweep_interval_seconds: float = 0.5
+    #: ack timeouts on one node before it is tainted NoSchedule (the
+    #: rebind must land elsewhere); the taint lifts on the next ack
+    node_suspect_threshold: int = 1
+    taint_suspect_nodes: bool = True
+
+
+@dataclass
 class FaultPointConfiguration:
     """One injection point's firing policy (robustness/faults.py)."""
 
@@ -308,4 +328,7 @@ class KubeSchedulerConfiguration:
     )
     tenancy: TenancyConfiguration = field(
         default_factory=TenancyConfiguration
+    )
+    bind_ack: BindAckConfiguration = field(
+        default_factory=BindAckConfiguration
     )

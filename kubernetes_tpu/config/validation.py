@@ -136,6 +136,15 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
                 "drfBias off arms nothing; disable tenancy instead"
             )
 
+    ba = getattr(cfg, "bind_ack", None)
+    if ba is not None and ba.enabled:
+        if ba.ack_timeout_seconds <= 0:
+            errors.append("bindAck.ackTimeout must be positive")
+        if ba.sweep_interval_seconds <= 0:
+            errors.append("bindAck.sweepInterval must be positive")
+        if ba.node_suspect_threshold < 1:
+            errors.append("bindAck.nodeSuspectThreshold must be >= 1")
+
     rs = getattr(cfg, "resilience", None)
     if rs is not None:
         if rs.sweep_interval_seconds <= 0:

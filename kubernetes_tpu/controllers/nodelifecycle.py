@@ -24,6 +24,7 @@ from kubernetes_tpu.api.types import (
 )
 from kubernetes_tpu.client.informer import InformerFactory
 from kubernetes_tpu.kubelet.hollow import LEASE_NAMESPACE
+from kubernetes_tpu.utils import flightrecorder, metrics
 
 logger = logging.getLogger(__name__)
 
@@ -109,6 +110,11 @@ class NodeLifecycleController:
 
         try:
             self.client.server.guaranteed_update("Node", "", name, mutate)
+            # the lapse mark + the per-pod taint_eviction marks below are
+            # the flight-recorder spine a post-mortem replays: the dump
+            # alone reconstructs every heartbeat-lapse eviction arc
+            metrics.node_heartbeat_lapses.inc()
+            flightrecorder.mark("heartbeat_lapse", node=name)
             logger.warning("node %s marked unreachable (stale lease)", name)
         except KeyError:
             pass
@@ -149,6 +155,11 @@ class NodeLifecycleController:
                     pod.metadata.namespace, pod.metadata.name
                 )
                 self.evictions += 1
+                metrics.taint_evictions.inc()
+                flightrecorder.mark(
+                    "taint_eviction", node=node_name,
+                    pod=pod.metadata.uid,
+                )
             except KeyError:
                 # already gone: OUR grant evicted nothing -- refund it
                 # (the reconcile would eventually recompute, but sibling

@@ -36,9 +36,14 @@ gate keeps apiserver-side creation cheap at 100k pods/s and makes
 
 Multi-active note: charge/refund are safe from N scheduler stacks (the
 apiserver serializes guaranteed_update), but ``sync_all``'s absolute
-rewrite should run in ONE stack (the controller-manager analogue);
-partitioned deployments wire the controller on the stack that owns the
-pod's home partition, exactly like the scheduling gate itself.
+rewrite must run in ONE stack (the controller-manager analogue): two
+concurrent absolute rewrites race adopt-then-rewrite and can clobber a
+charge the other just landed. Partitioned deployments therefore attach
+the partition coordinator (``partition_coordinator``); ``sync_all``
+then runs only on the elected singleton writer -- the stack holding
+the lowest live-held partition
+(PartitionCoordinator.elected_singleton_writer) -- and every other
+stack skips the rewrite (their charge/refund paths stay active).
 """
 
 from __future__ import annotations
@@ -101,6 +106,10 @@ class QuotaController:
         self._thread: Optional[threading.Thread] = None
         # wired by the scheduler (attach_queue): parked-pod accessors
         self._queue = None
+        #: multi-active mode: the stack's PartitionCoordinator; when
+        #: set, sync_all's absolute rewrite runs only on the elected
+        #: singleton writer (see module docstring)
+        self.partition_coordinator = None
         #: optional callback fired (namespace) whenever headroom may
         #: have appeared; the default release path goes through the
         #: attached queue directly
@@ -110,6 +119,7 @@ class QuotaController:
         self.admissions_denied = 0
         self.refunds = 0
         self.releases = 0
+        self.syncs_skipped_not_writer = 0
 
         self._quotas.add_event_handler(
             ResourceEventHandler(
@@ -484,8 +494,20 @@ class QuotaController:
         """Absolute used-recalculation (startup recovery / drift heal):
         adopt every BOUND, non-terminating pod into the charge ledger
         (a restarted scheduler has no in-flight charges to preserve),
-        then rewrite each quota's ``used`` from the ledger. Runs in one
-        stack (see module docstring)."""
+        then rewrite each quota's ``used`` from the ledger. Runs in ONE
+        stack: in multi-active partitioned mode only the elected
+        singleton writer (lowest live-held partition) performs the
+        absolute rewrite -- a second concurrent rewriter could adopt
+        the same bound pods and clobber a charge the first just landed
+        (see module docstring)."""
+        coord = self.partition_coordinator
+        if coord is not None and not coord.elected_singleton_writer():
+            self.syncs_skipped_not_writer += 1
+            logger.info(
+                "quota sync_all skipped: not the elected singleton "
+                "writer (lowest live-held partition is foreign)"
+            )
+            return
         with self._lock:
             bound_uids = {
                 uid for uid, (ns, _u) in self._charged.items()

@@ -9,6 +9,7 @@ running containers, completing the control loop
 substrate for the perf harness.
 """
 
+from kubernetes_tpu.kubelet.fleet import FleetConfig, HollowNodeFleet
 from kubernetes_tpu.kubelet.hollow import HollowKubelet, HollowNodePool
 
-__all__ = ["HollowKubelet", "HollowNodePool"]
+__all__ = ["FleetConfig", "HollowKubelet", "HollowNodeFleet", "HollowNodePool"]

@@ -29,6 +29,7 @@ import logging
 import threading
 from dataclasses import dataclass
 from typing import Dict, Optional
+from zlib import crc32
 
 from kubernetes_tpu.utils import flightrecorder, metrics
 
@@ -37,10 +38,28 @@ logger = logging.getLogger(__name__)
 #: the typed condition parked pods carry on the apiserver
 QUARANTINE_CONDITION = "PodQuarantined"
 
-#: strike ledger bound: per-uid entries beyond this evict oldest-first
-#: (a uid that bound long ago and never misbehaved again must not pin
-#: memory forever)
+#: strike ledger bound: entries beyond this evict oldest-first (a pod
+#: that bound long ago and never misbehaved again must not pin memory
+#: forever)
 _STRIKE_LEDGER_CAP = 4096
+
+
+def spec_identity(pod) -> str:
+    """The strike-ledger key: pod identity + a digest of its spec.
+
+    Keyed by uid, a controller that deletes and respawns its poison pod
+    (same spec, fresh uid) resets the strike budget every incarnation
+    and the quarantine never converges to a park. Keying by
+    namespace/name + spec digest makes the ledger survive respawns --
+    the replacement inherits its predecessor's strikes -- while a REAL
+    spec edit (the operator actually fixed the pod) changes the digest
+    and legitimately starts a fresh budget. The spec is a dataclass
+    tree, so ``repr`` is a deterministic canonical form of the declared
+    fields (runtime memo attributes never appear in it)."""
+    digest = crc32(repr(pod.spec).encode()) & 0xFFFFFFFF
+    return (
+        f"{pod.metadata.namespace}/{pod.metadata.name}#{digest:08x}"
+    )
 
 
 @dataclass
@@ -102,9 +121,11 @@ class QuarantineManager:
         self.holds = 0
         self.parks = 0
 
-    def strikes_of(self, uid: str) -> int:
+    def strikes_of(self, pod) -> int:
+        """Strikes charged against this pod's spec identity (shared
+        across incarnations of the same spec)."""
         with self._lock:
-            return self._strikes.get(uid, 0)
+            return self._strikes.get(spec_identity(pod), 0)
 
     def hold_for_strike(self, strike: int) -> float:
         cfg = self.config
@@ -121,10 +142,14 @@ class QuarantineManager:
         disposition ("held" | "parked")."""
         pod = pod_info.pod
         uid = pod.metadata.uid
+        # keyed by spec identity, NOT uid: a same-spec respawn (delete +
+        # recreate, fresh uid) inherits its predecessor's strikes, so a
+        # crash-looping controller can't reset the budget forever
+        key = spec_identity(pod)
         with self._lock:
-            strike = self._strikes.get(uid, 0) + 1
-            self._strikes[uid] = strike
-            self._strikes.move_to_end(uid)
+            strike = self._strikes.get(key, 0) + 1
+            self._strikes[key] = strike
+            self._strikes.move_to_end(key)
             while len(self._strikes) > _STRIKE_LEDGER_CAP:
                 self._strikes.popitem(last=False)
             self.isolations += 1

@@ -89,6 +89,22 @@ class FaultPoint:
     #: requeue machinery and the next dispatch rebuilds from the host
     #: cache via the cold-upload path (detection -> rebuilt is metered)
     DEVICE_LOST = "device_lost"
+    #: a hollow kubelet acks its binding LATE (evaluated per scheduled
+    #: ack): ``hang_seconds`` is added to the node's ack latency -- kept
+    #: under the scheduler's ack timeout in the shipped profile so slow
+    #: nodes exercise the ledger without tripping a rebind
+    SLOW_ACK = "slow_ack"
+    #: a hollow kubelet is a zombie (evaluated ONCE per node at fleet
+    #: build): heartbeats keep flowing but bindings are NEVER acked --
+    #: the silent-death shape only scheduler-side bind-ack tracking can
+    #: catch (the lifecycle monitor sees a live lease)
+    ZOMBIE_KUBELET = "zombie_kubelet"
+    #: a hollow kubelet stops heartbeating for ``hang_seconds``
+    #: (evaluated per heartbeat tick): the lease lapses, the
+    #: nodelifecycle monitor must mark the node unreachable and
+    #: taint-evict through the can_disrupt gate, then untaint when the
+    #: lease resumes
+    HEARTBEAT_LAPSE = "heartbeat_lapse"
 
     ALL = (
         DEVICE_SOLVE, DEVICE_SOLVE_HANG, SOLVE_GARBAGE, BIND_CONFLICT,
@@ -96,6 +112,9 @@ class FaultPoint:
         CRASH_BETWEEN_ASSUME_AND_BIND, WATCH_HISTORY_TRUNCATED,
         NODE_FLAP, RECLAIM_STORM, PREEMPT_SOLVE, VICTIM_SLOW_DEATH,
         POISON_POD, CARRY_CORRUPT, DEVICE_LOST,
+        # appended (never reordered): per-point RNG streams derive from
+        # the index into ALL, so existing profiles stay reproducible
+        SLOW_ACK, ZOMBIE_KUBELET, HEARTBEAT_LAPSE,
     )
 
 
@@ -444,6 +463,27 @@ def builtin_profiles() -> Dict[str, FaultProfile]:
                 ),
                 FaultPoint.DEVICE_LOST: PointConfig(
                     rate=0.1, max_fires=1
+                ),
+            },
+        ),
+        # hollow-node / closed-bind-loop chaos (ISSUE-17 acceptance
+        # shape): ~5% of acks run slow (still under the ack timeout, so
+        # the ledger books latency without rebinding), ~1% of hollow
+        # nodes are zombies (heartbeats flow, acks never come -- only
+        # bind-ack tracking catches them; their pods must rebind
+        # elsewhere exactly once per incarnation), and a bounded number
+        # of heartbeat lapses push nodes through the full
+        # unreachable -> taint-evict -> recover lifecycle arc
+        "kubelet-chaos": FaultProfile(
+            name="kubelet-chaos",
+            seed=0,
+            points={
+                FaultPoint.SLOW_ACK: PointConfig(
+                    rate=0.05, hang_seconds=0.25
+                ),
+                FaultPoint.ZOMBIE_KUBELET: PointConfig(rate=0.01),
+                FaultPoint.HEARTBEAT_LAPSE: PointConfig(
+                    rate=0.02, max_fires=4, hang_seconds=1.5
                 ),
             },
         ),

@@ -879,6 +879,22 @@ class BatchScheduler(Scheduler):
         with self._pending_cv:
             return bool(self._pending_q)
 
+    def _pending_head(self):
+        with self._pending_cv:
+            return self._pending_q[0] if self._pending_q else None
+
+    def _pending_first_unmirrored(self):
+        """First pending record whose commit has NOT passed the
+        shadow-mutation point (the mirror in ``_complete_solve``).
+        Mirrors land in FIFO order, so this record's ``carry_in`` is
+        the one snapshot that still equals the host shadows -- the
+        under-load carry audit's comparand."""
+        with self._pending_cv:
+            for p in self._pending_q:
+                if not p.get("mirrored"):
+                    return p
+        return None
+
     def _device_tiers(
         self, mode: str, b: int, n_cap: int, r_dims: int, u_rows: int
     ) -> List[str]:
@@ -2809,30 +2825,72 @@ class BatchScheduler(Scheduler):
         ControlPlaneReconciler sweep; safe to call from any thread.
 
         Returns the disposition: "idle" (nothing resident), "busy"
-        (batches in flight -- the carry is legitimately ahead of the
-        shadow), "raced" (a dispatch/commit moved the state mid-sweep),
-        "clean", or "mismatch" (healed)."""
+        (in-flight state with no auditable snapshot), "raced" (a
+        dispatch/commit moved the state mid-sweep), "clean", or
+        "mismatch" (healed).
+
+        A SATURATED pipeline no longer defers the audit to quiescence:
+        while batches are in flight, the FIRST UNMIRRORED pending
+        record's ``carry_in`` refs are audited instead of the live
+        carry. Those refs are immutable device arrays (dispatch
+        REASSIGNS ``ds.req_dev``, never mutates it) snapshotting the
+        device state that record's solve consumed -- which must equal
+        the host shadows exactly until that record's own commit passes
+        the shadow-mutation point (the mirror, flagged ``mirrored``
+        under this lock), because the committer lands batches in FIFO
+        order and the req/nzr shadows mutate ONLY at the mirror. The
+        coarse ``committing`` flag is deliberately NOT the gate: the
+        committer raises it the instant it grabs the head, long before
+        the mirror (the whole device download sits between), and gating
+        on it would answer "busy" for nearly every sweep under
+        saturation. Staleness is therefore bounded by pipeline depth,
+        not by the arrival rate ever pausing: corruption stamped into
+        the newest resident carry is seen when the batch that consumed
+        it reaches the front of the unmirrored window, at most
+        MAX_INFLIGHT commits later. Only req/nzr are audited under
+        load (the alloc row patch CAN land on the resident alloc while
+        batches are in flight); "busy" remains only for windows whose
+        front record has no carry reuse (cold uploads, row-fix
+        dispatches) or whose every record has already mirrored."""
         ds = self._dev
+        under_load = False
+        head = None
+        seq = 0
+        alloc_dev = valid_dev = None
+        shadow_ref = None
         with self._shadow_lock:
             if ds.req_dev is None or ds.req_shadow is None:
                 metrics.carry_audit_sweeps.inc(disposition="idle")
                 return "idle"
             if self._pending_exists():
-                metrics.carry_audit_sweeps.inc(disposition="busy")
-                return "busy"
-            seq = self._dispatch_seq
-            req_dev, nzr_dev = ds.req_dev, ds.nzr_dev
-            alloc_dev, valid_dev = ds.alloc_dev, ds.valid_dev
-            # host checksums under the lock: the shadows mutate in
-            # place at commit time
-            host = {
-                "req": _audit_checksum_host(ds.req_shadow),
-                "nzr": _audit_checksum_host(ds.nzr_shadow),
-            }
-            if alloc_dev is not None and ds.alloc_shadow is not None:
-                host["alloc"] = _audit_checksum_host(ds.alloc_shadow)
-            if valid_dev is not None and ds.valid_shadow is not None:
-                host["valid"] = _audit_checksum_host(ds.valid_shadow)
+                head = self._pending_first_unmirrored()
+                carry = (
+                    head.get("carry_in") if head is not None else None
+                )
+                if head is None or carry is None:
+                    metrics.carry_audit_sweeps.inc(disposition="busy")
+                    return "busy"
+                under_load = True
+                shadow_ref = ds.req_shadow
+                req_dev, nzr_dev = carry
+                host = {
+                    "req": _audit_checksum_host(ds.req_shadow),
+                    "nzr": _audit_checksum_host(ds.nzr_shadow),
+                }
+            else:
+                seq = self._dispatch_seq
+                req_dev, nzr_dev = ds.req_dev, ds.nzr_dev
+                alloc_dev, valid_dev = ds.alloc_dev, ds.valid_dev
+                # host checksums under the lock: the shadows mutate in
+                # place at commit time
+                host = {
+                    "req": _audit_checksum_host(ds.req_shadow),
+                    "nzr": _audit_checksum_host(ds.nzr_shadow),
+                }
+                if alloc_dev is not None and ds.alloc_shadow is not None:
+                    host["alloc"] = _audit_checksum_host(ds.alloc_shadow)
+                if valid_dev is not None and ds.valid_shadow is not None:
+                    host["valid"] = _audit_checksum_host(ds.valid_shadow)
         self.carry_audits += 1
         # device reductions OUTSIDE the lock (the refs are immutable
         # arrays; a racing dispatch reassigns, never mutates)
@@ -2847,11 +2905,25 @@ class BatchScheduler(Scheduler):
             for name, (s, ws) in dev_handles.items()
         }
         with self._shadow_lock:
-            if (
-                self._dispatch_seq != seq
-                or self._pending_exists()
-                or ds.req_dev is not req_dev
-            ):
+            if under_load:
+                # the snapshot is comparable until OUR record's mirror
+                # lands (the only in-order in-place writer of the
+                # req/nzr shadows) or a cold upload reassigns the
+                # shadow arrays -- both happen under this lock, so
+                # either landing mid-reduction is caught here. The
+                # coarse ``committing`` flag is irrelevant: the whole
+                # download phase is audit-safe.
+                raced = (
+                    head.get("mirrored")
+                    or ds.req_shadow is not shadow_ref
+                )
+            else:
+                raced = (
+                    self._dispatch_seq != seq
+                    or self._pending_exists()
+                    or ds.req_dev is not req_dev
+                )
+            if raced:
                 metrics.carry_audit_sweeps.inc(disposition="raced")
                 return "raced"
             mismatched = [n for n in dev if dev[n] != host[n]]
@@ -2878,7 +2950,7 @@ class BatchScheduler(Scheduler):
                 metrics.carry_audit_mismatches.inc(array=name)
             flightrecorder.mark(
                 "carry_audit", arrays=",".join(sorted(mismatched)),
-                rows=rows,
+                rows=rows, in_flight=len(self._pending_q),
             )
             if "req" in mismatched or "nzr" in mismatched:
                 ds.invalidate_carry()
@@ -3113,8 +3185,12 @@ class BatchScheduler(Scheduler):
         with self._shadow_lock:
             # the audit race-detector: a commit moving the shadow (or
             # landing a batch) invalidates any checksum window spanning
-            # this moment
+            # this moment. ``mirrored`` marks THIS record as past the
+            # shadow-mutation point -- the under-load audit compares the
+            # first unmirrored record's carry_in against the shadows,
+            # so the flag must flip under the same lock as the mirror.
             self._dispatch_seq += 1
+            p["mirrored"] = True
             if not p["overlaid"] and ds.req_shadow is not None:
                 # mirror the batch's own placements into the running
                 # expectation (same int32 arithmetic as the scan carry)
@@ -3929,6 +4005,18 @@ class BatchScheduler(Scheduler):
         recorder = bound[0][0].recorder if len(profs) == 1 else None
         with timeline.span("events+metrics"):
             self._emit_bound(recorder, bound)
+        # arm the bind-ack ledger: each committed bind is pending until
+        # its Running ack arrives over the watch (zombie-kubelet
+        # detection -- scheduler/bindack.py)
+        tracker = getattr(self, "bind_ack_tracker", None)
+        if tracker is not None:
+            tracker.track_bound([
+                (
+                    assumed.metadata.namespace, assumed.metadata.name,
+                    assumed.metadata.uid, host,
+                )
+                for _, _, _, assumed, host in bound
+            ])
 
     def _emit_bound(self, recorder, bound) -> None:
         if hasattr(recorder, "scheduled_many"):

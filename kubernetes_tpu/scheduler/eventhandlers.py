@@ -70,6 +70,10 @@ def add_all_event_handlers(
     # so the DRF shares stay honest without a second watch
     note_bound = getattr(sched, "note_pods_bound", None)
     note_unbound = getattr(sched, "note_pods_unbound", None)
+    # bind-ack tracker hooks (scheduler/bindack.py): cache-side frames
+    # carry the pod-Running ack transition and the gone signals the
+    # ledger consumes -- same watch, no second stream
+    ack_tracker = getattr(sched, "bind_ack_tracker", None)
 
     def _classify_safe(pod: Pod) -> None:
         try:
@@ -104,6 +108,8 @@ def add_all_event_handlers(
             logger.exception("add pod %s to cache", pod.key())
         if note_bound is not None:
             note_bound([pod])
+        if ack_tracker is not None:
+            ack_tracker.observe_pod(None, pod)
         # Targeted wake: only parked pods whose affinity terms match the
         # added pod can benefit (eventhandlers.go:90 assignedPodAdded ->
         # scheduling_queue.go:508). During a 10k-burst the cache sees one
@@ -119,6 +125,8 @@ def add_all_event_handlers(
             sched.cache.add_pod(new)
         except Exception:
             logger.exception("update pod %s in cache", new.key())
+        if ack_tracker is not None:
+            ack_tracker.observe_pod(old, new)
         sched.queue.assigned_pod_updated(new)
 
     def delete_pod_from_cache(pod: Pod) -> None:
@@ -128,6 +136,8 @@ def add_all_event_handlers(
             logger.exception("remove pod %s from cache", pod.key())
         if note_unbound is not None:
             note_unbound([pod])
+        if ack_tracker is not None:
+            ack_tracker.observe_gone(pod.metadata.uid)
         sched.queue.move_all_to_active_or_backoff_queue(events.AssignedPodDelete)
 
     # unscheduled pods owned by one of our profiles -> queue (:381)
@@ -339,6 +349,9 @@ def add_all_event_handlers(
                     logger.exception("bulk add pods to cache")
                 if note_bound is not None:
                     note_bound(payload)
+                if ack_tracker is not None:
+                    for pod in payload:
+                        ack_tracker.observe_pod(None, pod)
                 sched.queue.assigned_pods_added_many(payload)
             elif kind == "dels":
                 # one bulk cache remove + ONE queue move per run (a
@@ -349,6 +362,9 @@ def add_all_event_handlers(
                     logger.exception("bulk remove pods from cache")
                 if note_unbound is not None:
                     note_unbound(payload)
+                if ack_tracker is not None:
+                    for pod in payload:
+                        ack_tracker.observe_gone(pod.metadata.uid)
                 sched.queue.move_all_to_active_or_backoff_queue(
                     events.AssignedPodDelete
                 )

@@ -464,6 +464,34 @@ class PartitionCoordinator:
     def may_bind(self, node_name: str) -> bool:
         return self.holds_partition(self.node_partition(node_name))
 
+    def elected_singleton_writer(self) -> bool:
+        """Single-writer election for cluster-scoped reconcilers (the
+        quota ``sync_all`` absolute used-rewrite): the elected stack is
+        the one holding the LOWEST partition currently held by any live
+        stack. Every stack evaluates the same lease ground truth, so at
+        most one answers True per lease window -- two stacks can only
+        disagree across a takeover boundary, and the deposed holder's
+        next fresh read flips it False. Doubt (unreadable lease)
+        fences; no live holder at all (cold start, single stack racing
+        its very first claim round) elects self -- there is nobody to
+        race."""
+        now = self.clock()
+        server = self.client.server
+        ns = self.config.resource_namespace
+        for k in range(self.num_partitions):
+            try:
+                obj = server.get("Lease", ns, self._lease_name(k))
+            except KeyError:
+                continue  # never claimed: not held by anyone
+            except Exception:  # noqa: BLE001 - can't prove: fence
+                return False
+            if not obj.holder_identity:
+                continue
+            if obj.renew_time + obj.lease_duration_seconds <= now:
+                continue  # expired holder is not live
+            return obj.holder_identity == self.identity
+        return True
+
     def fence_hosts(self, hosts: List[str]) -> Set[int]:
         """Indexes of hosts this stack may NOT commit to right now; one
         fresh lease probe per unique partition, not per pod."""

@@ -112,6 +112,11 @@ class Scheduler:
         # echoes (eventhandlers), consumed by the batched solve order
         self.tenant_shares = None
         self.quota_denials = 0
+        # bind-ack ledger (scheduler/bindack.py): when attached, every
+        # committed bind is pending until the node's Running ack arrives
+        # over the watch; overdue pods are unbound back to the queue
+        # (exactly once per incarnation). None = bind-and-forget.
+        self.bind_ack_tracker = None
 
     # -- profile lookup (scheduler.go:741 profileForPod) --------------------
 
@@ -688,6 +693,8 @@ class Scheduler:
     def stop(self) -> None:
         self._stop.set()
         self.queue.close()
+        if self.bind_ack_tracker is not None:
+            self.bind_ack_tracker.stop()
         broadcaster = getattr(self, "event_broadcaster", None)
         if broadcaster is not None:
             # let in-flight binding cycles record their events before the
@@ -717,6 +724,7 @@ def new_scheduler(
     extenders: Optional[List] = None,
     robustness_config=None,
     containment_config=None,
+    bind_ack_config=None,
 ) -> Scheduler:
     """Build a fully wired scheduler (reference scheduler.go:223 New +
     factory.go create). ``batch=True`` selects the TPU batch-solver loop
@@ -830,6 +838,23 @@ def new_scheduler(
 
         sched.preemptor.ladder = SolverLadder(sched.ladder.config)
     sched.event_broadcaster = broadcaster
+    # the bind-ack ledger must exist BEFORE handler registration: the
+    # eventhandlers capture it once and feed it the Running-ack frames
+    if (
+        bind_ack_config is not None
+        and getattr(bind_ack_config, "enabled", False)
+        and client is not None
+    ):
+        from kubernetes_tpu.scheduler.bindack import BindAckTracker
+
+        sched.bind_ack_tracker = BindAckTracker(
+            client,
+            ack_timeout_seconds=bind_ack_config.ack_timeout_seconds,
+            sweep_interval_seconds=bind_ack_config.sweep_interval_seconds,
+            node_suspect_threshold=bind_ack_config.node_suspect_threshold,
+            taint_suspect_nodes=bind_ack_config.taint_suspect_nodes,
+        )
+        sched.bind_ack_tracker.start()
     add_all_event_handlers(sched, informer_factory)
     # materialize every plugin-consumed informer BEFORE factory start so
     # listers are synced by WaitForCacheSync (reference factory.go shape)
@@ -901,6 +926,7 @@ def new_scheduler_from_config(
         containment_config=ContainmentConfig.from_configuration(
             cfg.containment
         ),
+        bind_ack_config=getattr(cfg, "bind_ack", None),
     )
     if ts.enabled:
         sched.batch_window = ts.batch_window_seconds

@@ -293,6 +293,13 @@ def arm_tenancy(
 
         qc = QuotaController(client, informer_factory)
         qc.attach_queue(sched.queue)
+        # multi-active: sync_all's absolute rewrite elects a single
+        # writer through the partition coordinator (attach_partitioning
+        # runs before arm_tenancy in SchedulerApp, so the attribute is
+        # live here when partitioning is on)
+        qc.partition_coordinator = getattr(
+            sched, "partition_coordinator", None
+        )
         sched.quota = qc
     if drf_bias:
         tracker = TenantShareTracker()

@@ -688,6 +688,64 @@ pod_to_bind_quantile = registry.register(Gauge(
     ("q",),
 ))
 
+# hollow-node plane (ISSUE 17): the bind loop is closed -- a bind is
+# only done when the node agent acks it into pod status -- so the ack
+# path, the heartbeat plane, and the zombie-recovery arc each get their
+# own families (README "Closing the bind loop" reads these)
+hollow_acks = registry.register(Counter(
+    "scheduler_hollow_acks_total",
+    "Bindings acked into pod status (phase=Running) by the hollow-node "
+    "fleet -- the kubelet syncLoop edge that closes the bind loop.",
+))
+hollow_heartbeats = registry.register(Counter(
+    "scheduler_hollow_heartbeats_total",
+    "Lease renewals written by the hollow-node fleet.",
+))
+bind_acks_observed = registry.register(Counter(
+    "scheduler_bind_acks_total",
+    "Bind acks observed by the scheduler's bind-ack tracker (the "
+    "pod-Running transition arriving over the watch), by how: acked = "
+    "the node confirmed in time; acked-late = the ack raced the "
+    "rebind sweep and won at the store.",
+    ("how",),
+))
+bind_ack_latency = registry.register(Histogram(
+    "scheduler_bind_ack_latency_seconds",
+    "Bind-to-ack latency: bulk bind commit to the pod-Running ack "
+    "arriving over the watch.",
+))
+bind_ack_timeouts = registry.register(Counter(
+    "scheduler_bind_ack_timeouts_total",
+    "Bound pods whose ack never arrived within the ack timeout (the "
+    "zombie-kubelet signal; each feeds the rebind path exactly once "
+    "per pod incarnation).",
+))
+rebinds = registry.register(Counter(
+    "scheduler_rebinds_total",
+    "Bound-but-never-acked pods unbound back to the queue by the "
+    "rebind-after-timeout sweep (uid-fenced: at most one per pod "
+    "incarnation).",
+))
+bind_ack_pending = registry.register(Gauge(
+    "scheduler_bind_ack_pending",
+    "Bound pods currently awaiting their node's ack.",
+))
+suspect_nodes_tainted = registry.register(Counter(
+    "scheduler_bind_ack_suspect_nodes_tainted_total",
+    "Nodes tainted unschedulable by the bind-ack tracker after "
+    "repeated ack timeouts (cleared when the node acks again).",
+))
+node_heartbeat_lapses = registry.register(Counter(
+    "scheduler_node_heartbeat_lapses_total",
+    "Nodes marked unreachable by the nodelifecycle monitor after their "
+    "lease lapsed past the grace period.",
+))
+taint_evictions = registry.register(Counter(
+    "scheduler_taint_evictions_total",
+    "Pods evicted off unreachable nodes by the nodelifecycle monitor "
+    "(every one granted through the shared can_disrupt PDB gate).",
+))
+
 from kubernetes_tpu.utils.quantiles import QuantileSet as _QuantileSet
 
 #: the live pod-to-bind sketch the gauges read at scrape time; the

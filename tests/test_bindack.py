@@ -1,0 +1,504 @@
+"""Closed-loop bind acks (ISSUE 17): the BindAckTracker ledger,
+zombie-kubelet rebind-after-timeout, the heartbeat-lapse eviction-storm
+guard, and the kubelet-chaos tier-1 guard.
+
+The contracts under test:
+
+- the tracker books Running transitions as acks, unbinds overdue pods
+  EXACTLY once per incarnation (uid-fenced -- a second timeout on the
+  same uid is surfaced, never looped), books the ack-wins-race as
+  ``acked-late``, and taints/untaints suspect nodes;
+- zombie e2e: pods bound to a never-acking node rebind elsewhere and
+  reach Running, pinned by a uid-keyed replay of the apiserver watch
+  history (one unbind per uid, zero double-binds);
+- heartbeat-lapse storm: every taint eviction routes through the shared
+  DisruptionController.can_disrupt budget -- the ledger stays balanced
+  and no PDB budget ever goes negative;
+- kubelet-chaos guard: a 1k-pod burst under the builtin profile (5%
+  slow acks, a zombie node, bounded heartbeat lapses) converges to 100%
+  Running with exactly-once rebinds, zero double-binds, and a
+  flight-recorder dump that alone reconstructs every rebind and every
+  heartbeat-lapse eviction.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    POD_RUNNING,
+    TAINT_EFFECT_NO_EXECUTE,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.config.types import BindAckConfiguration
+from kubernetes_tpu.controllers import (
+    DisruptionController,
+    NodeLifecycleController,
+)
+from kubernetes_tpu.controllers.nodelifecycle import TAINT_UNREACHABLE
+from kubernetes_tpu.kubelet import FleetConfig, HollowNodeFleet
+from kubernetes_tpu.robustness.faults import (
+    FaultInjector,
+    install_injector,
+    load_profile,
+)
+from kubernetes_tpu.scheduler.bindack import (
+    BindAckTracker,
+    TAINT_BIND_ACK_TIMEOUT,
+)
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+from kubernetes_tpu.utils import flightrecorder
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    yield
+    install_injector(None)
+
+
+def _wait(pred, timeout, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _pod_timelines(server):
+    """uid -> [(event_type, node_name, phase)] in watch-history order:
+    the replay that pins exactly-once rebinds and zero double-binds."""
+    out = {}
+    for ev in server._history["Pod"]:
+        out.setdefault(ev.object.metadata.uid, []).append(
+            (ev.type, ev.object.spec.node_name, ev.object.status.phase)
+        )
+    return out
+
+
+def _unbinds_and_doublebinds(timelines):
+    """Per uid: bound->unbound transitions, and direct node->other-node
+    rewrites (a double-bind -- must never happen)."""
+    unbinds, double_binds = {}, []
+    for uid, frames in timelines.items():
+        prev_node = None
+        for _type, node, _phase in frames:
+            if prev_node and not node:
+                unbinds[uid] = unbinds.get(uid, 0) + 1
+            if prev_node and node and node != prev_node:
+                double_binds.append((uid, prev_node, node))
+            prev_node = node
+    return unbinds, double_binds
+
+
+class TestBindAckTracker:
+    def _env(self, **kw):
+        server = APIServer()
+        client = Client(server)
+        for n in ("n0", "n1"):
+            client.create_node(
+                make_node(n).capacity(cpu="8", memory="16Gi").obj()
+            )
+        tracker = BindAckTracker(client, **kw)
+        return server, client, tracker
+
+    def _bound(self, client, name="p", node="n0"):
+        client.create_pod(
+            make_pod(name).node(node).container(cpu="1").obj()
+        )
+        return client.get_pod("default", name)
+
+    def test_running_transition_is_the_ack(self):
+        server, client, tracker = self._env(ack_timeout_seconds=60.0)
+        pod = self._bound(client)
+        tracker.track_bound([("default", "p", pod.metadata.uid, "n0")])
+        assert tracker.pending_count() == 1
+        client.update_pod_status(
+            "default", "p",
+            lambda p: setattr(p.status, "phase", POD_RUNNING),
+        )
+        tracker.observe_pod(pod, client.get_pod("default", "p"))
+        assert tracker.pending_count() == 0
+        assert tracker.acks == 1
+        assert tracker.sweep() == 0  # nothing overdue, nothing unbound
+
+    def test_timeout_unbinds_exactly_once_per_incarnation(self):
+        server, client, tracker = self._env(
+            ack_timeout_seconds=0.05, node_suspect_threshold=1,
+        )
+        pod = self._bound(client)
+        uid = pod.metadata.uid
+        tracker.track_bound([("default", "p", uid, "n0")])
+        time.sleep(0.1)
+        assert tracker.sweep() == 1
+        after = client.get_pod("default", "p")
+        assert after.spec.node_name == ""
+        assert tracker.rebinds == 1 and tracker.timeouts == 1
+        # the suspect node is tainted NoSchedule: the rebind cannot
+        # re-pick the zombie
+        node = client.get_node("n0")
+        assert any(
+            t.key == TAINT_BIND_ACK_TIMEOUT for t in node.spec.taints
+        )
+        # the rebind lands on n1... and n1 ALSO never acks: the uid
+        # fence surfaces the second timeout and leaves the pod bound
+        server.guaranteed_update(
+            "Pod", "default", "p",
+            lambda p: setattr(p.spec, "node_name", "n1"),
+        )
+        tracker.track_bound([("default", "p", uid, "n1")])
+        time.sleep(0.1)
+        assert tracker.sweep() == 0
+        assert tracker.timeouts == 2
+        assert client.get_pod("default", "p").spec.node_name == "n1"
+        assert tracker.pending_count() == 0  # surfaced, not re-armed
+
+    def test_ack_wins_the_unbind_race_booked_late(self):
+        server, client, tracker = self._env(ack_timeout_seconds=0.05)
+        pod = self._bound(client)
+        tracker.track_bound([("default", "p", pod.metadata.uid, "n0")])
+        # the kubelet ack lands before the sweep: the store refuses the
+        # unbind with the typed ``acked`` conflict
+        client.update_pod_status(
+            "default", "p",
+            lambda p: setattr(p.status, "phase", POD_RUNNING),
+        )
+        time.sleep(0.1)
+        assert tracker.sweep() == 0
+        assert tracker.acks_late == 1
+        assert tracker.rebinds == 0
+        assert client.get_pod("default", "p").spec.node_name == "n0"
+
+    def test_ack_from_suspect_node_untaints(self):
+        server, client, tracker = self._env(
+            ack_timeout_seconds=0.05, node_suspect_threshold=1,
+        )
+        pod = self._bound(client, name="slow")
+        tracker.track_bound([("default", "slow", pod.metadata.uid, "n0")])
+        time.sleep(0.1)
+        tracker.sweep()
+        assert any(
+            t.key == TAINT_BIND_ACK_TIMEOUT
+            for t in client.get_node("n0").spec.taints
+        )
+        # a later pod on the same node DOES ack: the sync loop is alive
+        other = self._bound(client, name="ok")
+        tracker.track_bound([("default", "ok", other.metadata.uid, "n0")])
+        client.update_pod_status(
+            "default", "ok",
+            lambda p: setattr(p.status, "phase", POD_RUNNING),
+        )
+        tracker.observe_pod(other, client.get_pod("default", "ok"))
+        assert not any(
+            t.key == TAINT_BIND_ACK_TIMEOUT
+            for t in client.get_node("n0").spec.taints
+        )
+
+    def test_deleted_pod_leaves_the_ledger(self):
+        server, client, tracker = self._env(ack_timeout_seconds=0.05)
+        pod = self._bound(client)
+        tracker.track_bound([("default", "p", pod.metadata.uid, "n0")])
+        client.delete_pod("default", "p")
+        tracker.observe_gone(pod.metadata.uid)
+        time.sleep(0.1)
+        assert tracker.sweep() == 0
+        assert tracker.pending_count() == 0
+
+
+class TestZombieKubeletE2E:
+    def test_rebind_lands_elsewhere_exactly_once(self):
+        """Bound-but-never-acked pods on the zombie node are unbound
+        after the ack timeout and rebind on a live node; the watch
+        history pins one unbind per uid and zero double-binds."""
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(
+            client, informers, batch=True, max_batch=16,
+            bind_ack_config=BindAckConfiguration(
+                enabled=True, ack_timeout_seconds=0.6,
+                sweep_interval_seconds=0.1,
+            ),
+        )
+        names = ["n0", "n1", "n2"]
+        for n in names:
+            client.create_node(
+                make_node(n).capacity(cpu="16", memory="32Gi", pods=110)
+                .obj()
+            )
+        fleet = HollowNodeFleet(
+            client, names,
+            FleetConfig(heartbeat_interval_seconds=0.2),
+        )
+        fleet.mark_zombie(["n0"])
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        fleet.start()
+        for i in range(9):
+            client.create_pod(
+                make_pod(f"p{i}").container(cpu="500m", memory="256Mi")
+                .obj()
+            )
+        sched.start()
+        try:
+            assert _wait(
+                lambda: sum(
+                    1 for p in client.list_pods()[0]
+                    if p.status.phase == POD_RUNNING
+                ) == 9,
+                60,
+            ), "zombie-held pods never converged to Running"
+        finally:
+            sched.stop()
+            fleet.stop()
+            informers.stop()
+        pods, _ = client.list_pods()
+        assert all(p.spec.node_name != "n0" for p in pods), (
+            "a Running pod sits on the zombie node"
+        )
+        tracker = sched.bind_ack_tracker
+        assert tracker.rebinds >= 1, "no bind ever targeted the zombie?"
+        # the zombie stays tainted: it never acked anything
+        assert any(
+            t.key == TAINT_BIND_ACK_TIMEOUT
+            for t in client.get_node("n0").spec.taints
+        )
+        # uid-keyed watch-history replay: exactly-once per incarnation
+        timelines = _pod_timelines(server)
+        unbinds, double_binds = _unbinds_and_doublebinds(timelines)
+        assert not double_binds, double_binds
+        assert all(n == 1 for n in unbinds.values()), unbinds
+        # every uid that ever sat on the zombie and survived was
+        # rebound exactly once
+        zombie_uids = {
+            uid for uid, frames in timelines.items()
+            if any(node == "n0" for _t, node, _p in frames)
+        }
+        assert zombie_uids, "no bind ever landed on the zombie"
+        assert zombie_uids == set(unbinds)
+        assert tracker.rebinds == len(zombie_uids)
+
+
+class TestHeartbeatLapseStormGuard:
+    def test_evictions_route_through_shared_budget(self):
+        """Three nodes lapse at once over a PDB-guarded workload: only
+        the budget's worth of pods is evicted, the rest are BLOCKED (not
+        dropped), and no PDB ledger ever goes negative."""
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        clock = {"now": 1000.0}
+        disruption = DisruptionController(client, informers)
+        ctrl = NodeLifecycleController(
+            client, informers, grace_period=40.0,
+            now=lambda: clock["now"], disruption=disruption,
+        )
+        names = ["n0", "n1", "n2"]
+        for n in names:
+            client.create_node(
+                make_node(n).capacity(cpu="16", memory="32Gi").obj()
+            )
+        from kubernetes_tpu.api.types import (
+            LabelSelector,
+            PodDisruptionBudget,
+        )
+
+        pdb = PodDisruptionBudget(
+            selector=LabelSelector(match_labels={"app": "web"}),
+            min_available=4,
+        )
+        pdb.metadata.name = "guard"
+        pdb.metadata.namespace = "default"
+        client.create_pdb(pdb)
+        for i in range(6):
+            client.create_pod(
+                make_pod(f"w{i}").labels(app="web").node(names[i % 3])
+                .container(cpu="1").obj()
+            )
+        fleet = HollowNodeFleet(
+            client, names, FleetConfig(), now=lambda: clock["now"]
+        )
+        fleet.heartbeat_once()
+        informers.pods().pump()
+        informers.nodes().pump()
+        informers.pdbs().pump()
+        disruption.sync_all()  # 6 healthy - 4 minAvailable = 2 allowed
+        # every heartbeat stops at once: the storm
+        clock["now"] += 120.0
+        ctrl.monitor_once()
+        # all three nodes unreachable, but the eviction wave is bounded
+        # by the SHARED budget: 2 evicted, 4 blocked, zero negative
+        for n in names:
+            assert any(
+                t.key == TAINT_UNREACHABLE
+                and t.effect == TAINT_EFFECT_NO_EXECUTE
+                for t in client.get_node(n).spec.taints
+            )
+        assert ctrl.evictions == 2
+        assert ctrl.evictions_blocked == 4
+        assert len(client.list_pods()[0]) == 4
+        status = client.get(
+            "PodDisruptionBudget", "default", "guard"
+        ).status
+        assert status.disruptions_allowed == 0  # spent, never negative
+        # the ledger balances: every intolerant pod was either evicted
+        # or blocked -- none silently dropped
+        assert ctrl.evictions + ctrl.evictions_blocked == 6
+        # repeated passes while stale never push the budget negative
+        ctrl.monitor_once()
+        status = client.get(
+            "PodDisruptionBudget", "default", "guard"
+        ).status
+        assert status.disruptions_allowed == 0
+        assert ctrl.evictions == 2
+
+
+class TestKubeletChaosGuard:
+    def test_1k_burst_converges_with_reconstructable_dump(self):
+        """The tier-1 acceptance guard: 1000 pods over 100 hollow nodes
+        under the builtin kubelet-chaos profile (5% slow acks, one
+        zombie node, bounded heartbeat lapses with a live lifecycle
+        monitor evicting through the PDB gate). Everything converges to
+        Running; the watch history pins exactly-once rebinds and zero
+        double-binds; the flight-recorder dump alone reconstructs every
+        rebind and every heartbeat-lapse eviction."""
+        flightrecorder.RECORDER.reset()
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(
+            client, informers, batch=True, max_batch=256,
+            bind_ack_config=BindAckConfiguration(
+                enabled=True, ack_timeout_seconds=2.5,
+                sweep_interval_seconds=0.25,
+            ),
+        )
+        names = [f"node-{i}" for i in range(100)]
+        for n in names:
+            client.create_node(
+                make_node(n).capacity(cpu="32", memory="64Gi", pods=110)
+                .obj()
+            )
+        # build the fleet BEFORE installing the profile so the zombie
+        # set is pinned to exactly one node (1%) regardless of the
+        # profile's per-node draw; slow acks + lapses still draw live
+        fleet = HollowNodeFleet(
+            client, names,
+            FleetConfig(shard_size=25, heartbeat_interval_seconds=0.25),
+        )
+        install_injector(FaultInjector(load_profile("kubelet-chaos")))
+        fleet.mark_zombie(["node-0"])
+        disruption = DisruptionController(client, informers)
+        monitor = NodeLifecycleController(
+            client, informers, grace_period=0.9, monitor_interval=0.1,
+            disruption=disruption,
+        )
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        fleet.start()
+        expected = [f"p{i}" for i in range(1000)]
+        for name in expected:
+            client.create_pod(
+                make_pod(name).container(cpu="250m", memory="128Mi").obj()
+            )
+        sched.start()
+        monitor.start()
+        # the replacement controller: evicted pods respawn (same name,
+        # fresh uid) so "100% Running" is well-defined under evictions
+        stop_respawn = threading.Event()
+
+        def respawn():
+            while not stop_respawn.is_set():
+                live = {p.metadata.name for p in client.list_pods()[0]}
+                for name in expected:
+                    if name not in live:
+                        try:
+                            client.create_pod(
+                                make_pod(name)
+                                .container(cpu="250m", memory="128Mi")
+                                .obj()
+                            )
+                        except ValueError:
+                            pass  # lost the respawn race: fine
+                stop_respawn.wait(0.2)
+
+        respawner = threading.Thread(target=respawn, daemon=True)
+        respawner.start()
+
+        def all_running():
+            pods, _ = client.list_pods()
+            return (
+                len(pods) == 1000
+                and all(p.status.phase == POD_RUNNING for p in pods)
+            )
+
+        try:
+            assert _wait(all_running, 120, interval=0.25), (
+                "kubelet-chaos burst never converged to 100% Running"
+            )
+        finally:
+            stop_respawn.set()
+            respawner.join(timeout=2)
+            monitor.stop()
+            sched.stop()
+            fleet.stop()
+            informers.stop()
+        pods, _ = client.list_pods()
+        assert all(p.spec.node_name != "node-0" for p in pods), (
+            "a Running pod sits on the zombie"
+        )
+        # -- uid-keyed watch-history replay -------------------------------
+        timelines = _pod_timelines(server)
+        unbinds, double_binds = _unbinds_and_doublebinds(timelines)
+        assert not double_binds, double_binds
+        assert all(n == 1 for n in unbinds.values()), (
+            "a uid was unbound more than once per incarnation"
+        )
+        # every surviving incarnation that sat on the zombie rebound
+        # exactly once (evicted incarnations legitimately end DELETED)
+        deleted = {
+            uid for uid, frames in timelines.items()
+            if frames[-1][0] == "DELETED"
+        }
+        zombie_uids = {
+            uid for uid, frames in timelines.items()
+            if any(node == "node-0" for _t, node, _p in frames)
+        }
+        assert zombie_uids, "no bind ever landed on the zombie node"
+        for uid in zombie_uids - deleted:
+            assert unbinds.get(uid) == 1, (
+                f"zombie-held uid {uid} was not rebound exactly once"
+            )
+        # -- the dump alone reconstructs the story ------------------------
+        dump = flightrecorder.RECORDER.dump()
+        rebind_marks = {
+            m["pod"] for m in dump["marks"] if m["kind"] == "rebind"
+        }
+        assert rebind_marks == set(unbinds), (
+            "flight-recorder rebind marks diverge from the history replay"
+        )
+        eviction_marks = {
+            m["pod"] for m in dump["marks"]
+            if m["kind"] == "taint_eviction"
+        }
+        assert eviction_marks == deleted, (
+            "flight-recorder eviction marks diverge from the deletions"
+        )
+        if monitor.evictions:
+            # lapses fired: each eviction arc is anchored by its node's
+            # heartbeat_lapse mark
+            lapsed_nodes = {
+                m["node"] for m in dump["marks"]
+                if m["kind"] == "heartbeat_lapse"
+            }
+            evicted_from = {
+                m["node"] for m in dump["marks"]
+                if m["kind"] == "taint_eviction"
+            }
+            assert evicted_from <= lapsed_nodes

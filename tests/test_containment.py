@@ -69,6 +69,7 @@ def thread_crashes(monkeypatch):
 
 def _mk_cluster(
     num_nodes=16, max_batch=128, containment=None, capacity_cpu="32",
+    capacity_pods=110,
 ):
     server = APIServer()
     client = Client(server)
@@ -96,7 +97,7 @@ def _mk_cluster(
     for i in range(num_nodes):
         client.create_node(
             make_node(f"node-{i}")
-            .capacity(cpu=capacity_cpu, memory="64Gi", pods=110)
+            .capacity(cpu=capacity_cpu, memory="64Gi", pods=capacity_pods)
             .obj()
         )
     informers.start()
@@ -397,6 +398,118 @@ class TestCarryIntegrityAudit:
         )
         assert _wait(lambda: sched.audit_carry() == "clean", 10)
         # zero wrong placements: no node over capacity
+        assert not _overcommitted_nodes(client)
+        assert not thread_crashes, [
+            str(c.exc_value) for c in thread_crashes
+        ]
+        sched.stop()
+        informers.stop()
+
+
+class TestAuditUnderLoad:
+    def test_audit_concludes_without_quiescence(self, thread_crashes):
+        """Bounded staleness (ISSUE 17 satellite): a SATURATED pipeline
+        must not defer the carry audit to quiescence. With the
+        committer artificially slowed so the pending queue never
+        drains, the audit still concludes ("clean"/"mismatch", never a
+        wall of "busy") by checksumming the first unmirrored pending
+        record's ``carry_in`` -- and a CARRY_CORRUPT stamped into the
+        stream is detected while batches remain in flight, within
+        pipeline depth rather than "whenever arrivals pause"."""
+        server, client, informers, sched = _mk_cluster(
+            num_nodes=8, max_batch=16, capacity_pods=4000,
+        )
+        sched.start()
+        # warm the carry so dispatches reuse it (carry_in present)
+        for i in range(20):
+            client.create_pod(
+                make_pod(f"warm-{i}")
+                .container(cpu="100m", memory="64Mi").obj()
+            )
+        assert _wait(
+            lambda: all(
+                f"warm-{i}" in _bound_map(client) for i in range(20)
+            ),
+            60,
+        )
+        sched.wait_for_inflight_binds()
+
+        # slow the committer: every commit now parks 0.2s BEFORE the
+        # mirror, exactly the committing-but-unmirrored window the old
+        # coarse gate refused as "busy"
+        orig_complete = sched._complete_solve
+
+        def slow_complete(p):
+            time.sleep(0.2)
+            return orig_complete(p)
+
+        sched._complete_solve = slow_complete
+
+        stop_feeding = threading.Event()
+
+        def feeder():
+            i = 0
+            while not stop_feeding.is_set():
+                try:
+                    client.create_pod(
+                        make_pod(f"load-{i}").container(cpu="10m").obj()
+                    )
+                except Exception:  # noqa: BLE001 - feeder is best-effort
+                    pass
+                i += 1
+                time.sleep(0.02)
+
+        t = threading.Thread(target=feeder, daemon=True)
+        t.start()
+        try:
+            # audits sampled while the queue is verifiably occupied on
+            # BOTH sides of the call must conclude, not answer busy
+            in_flight_conclusions = 0
+            busy_in_flight = 0
+            deadline = time.time() + 30
+            while time.time() < deadline and in_flight_conclusions < 3:
+                if not sched._pending_exists():
+                    time.sleep(0.01)
+                    continue
+                out = sched.audit_carry()
+                if not sched._pending_exists():
+                    continue  # drained mid-call: not an in-flight sample
+                if out in ("clean", "mismatch"):
+                    in_flight_conclusions += 1
+                elif out == "busy":
+                    busy_in_flight += 1
+                time.sleep(0.03)
+            assert in_flight_conclusions >= 3, (
+                f"audit never concluded under load "
+                f"(busy={busy_in_flight})"
+            )
+
+            # corruption under CONTINUOUS load: detected without the
+            # feeder ever pausing
+            inj = FaultInjector(FaultProfile(
+                "corrupt-under-load", seed=0,
+                points={FaultPoint.CARRY_CORRUPT: PointConfig(
+                    rate=1.0, max_fires=1
+                )},
+            ))
+            install_injector(inj)
+            assert _wait(
+                lambda: inj.fired_count(FaultPoint.CARRY_CORRUPT) == 1,
+                20,
+            ), "corruption never fired"
+            assert _wait(
+                lambda: sched.audit_carry() == "mismatch", 20, 0.02
+            ), "audit never detected corruption while loaded"
+            assert sched.carry_audit_heals >= 1
+        finally:
+            stop_feeding.set()
+            t.join(timeout=5)
+            sched._complete_solve = orig_complete
+        # drain and verify the heal held: no over-capacity placements
+        sched.wait_for_inflight_binds()
+        assert _wait(
+            lambda: sched.audit_carry() in ("clean", "idle"), 10
+        )
         assert not _overcommitted_nodes(client)
         assert not thread_crashes, [
             str(c.exc_value) for c in thread_crashes

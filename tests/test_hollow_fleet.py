@@ -1,0 +1,311 @@
+"""Hollow-node plane (ISSUE 17): routed watch fan-out, the unbind
+primitive, and the sharded HollowNodeFleet.
+
+The contracts under test:
+
+- RoutedWatch delivers an event ONLY to the watchers registered for its
+  route key (Pod -> spec.nodeName): uninterested cursors see nothing,
+  events that never had a route (unbound pods) are invisible, retained
+  history replays filtered through ``since_rv``, and a stalled consumer
+  overflows to ``Gone`` (the 410 relist contract);
+- ``unbind`` atomically releases a binding under the store lock, fenced
+  by uid, node, and the Running phase (a kubelet ack that lands first
+  WINS as a typed ``acked`` conflict);
+- the fleet acks bindings into Running, renews Leases + Ready, drifts
+  allocatable within bounds, suppresses acks on zombies, goes fully
+  silent when dark, and refuses a stale ack for a rebound incarnation
+  inside the status mutate.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import POD_RUNNING, RESOURCE_PODS
+from kubernetes_tpu.apiserver.server import (
+    APIServer,
+    BindConflict,
+    Gone,
+)
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.kubelet import FleetConfig, HollowNodeFleet
+from kubernetes_tpu.kubelet.hollow import LEASE_NAMESPACE
+from kubernetes_tpu.robustness.faults import install_injector
+from kubernetes_tpu.scheduler.scheduler import new_scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    yield
+    install_injector(None)
+
+
+def _wait(pred, timeout, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class TestRoutedWatch:
+    def test_delivers_only_to_interested_routes(self):
+        server = APIServer()
+        client = Client(server)
+        _, rv = server.list("Pod")
+        w0 = server.watch_routes("Pod", {"n0"}, since_rv=rv)
+        w1 = server.watch_routes("Pod", {"n1"}, since_rv=rv)
+        client.create_pod(make_pod("a").node("n0").container(cpu="1").obj())
+        client.create_pod(make_pod("b").node("n1").container(cpu="1").obj())
+        evs0 = w0.pending()
+        evs1 = w1.pending()
+        assert [e.object.metadata.name for e in evs0] == ["a"]
+        assert [e.object.metadata.name for e in evs1] == ["b"]
+        # nothing queued behind: one dict probe routed each event once
+        assert w0.pending() == [] and w1.pending() == []
+
+    def test_unrouted_events_are_invisible(self):
+        """An unbound pod has no route key: a kubelet's filtered watch
+        never sees it until spec.nodeName points at it."""
+        server = APIServer()
+        client = Client(server)
+        _, rv = server.list("Pod")
+        w = server.watch_routes("Pod", {"n0"}, since_rv=rv)
+        client.create_pod(make_pod("floating").container(cpu="1").obj())
+        assert w.pending() == []
+        # the bind MODIFIED carries the route: now it arrives
+        server.guaranteed_update(
+            "Pod", "default", "floating",
+            lambda p: setattr(p.spec, "node_name", "n0"),
+        )
+        evs = w.pending()
+        assert [e.object.metadata.name for e in evs] == ["floating"]
+
+    def test_replay_since_rv(self):
+        """The list+watch handshake: retained history after since_rv is
+        replayed (filtered) at registration."""
+        server = APIServer()
+        client = Client(server)
+        client.create_pod(make_pod("old").node("n0").container(cpu="1").obj())
+        _, rv = server.list("Pod")
+        client.create_pod(make_pod("new").node("n0").container(cpu="1").obj())
+        client.create_pod(make_pod("other").node("n9").container(cpu="1").obj())
+        w = server.watch_routes("Pod", {"n0"}, since_rv=rv)
+        evs = w.pending()
+        # only the post-rv event for OUR route; "old" is pre-rv, "other"
+        # routes elsewhere
+        assert [e.object.metadata.name for e in evs] == ["new"]
+
+    def test_stalled_consumer_overflows_to_gone(self):
+        server = APIServer(watch_history_limit=8)
+        client = Client(server)
+        _, rv = server.list("Pod")
+        w = server.watch_routes("Pod", {"n0"}, since_rv=rv)
+        for i in range(10):
+            client.create_pod(
+                make_pod(f"p{i}").node("n0").container(cpu="1").obj()
+            )
+        with pytest.raises(Gone):
+            w.pending()
+        # the Gone drained the overflow: the consumer relists and the
+        # cursor is usable again
+        client.create_pod(make_pod("fresh").node("n0").container(cpu="1").obj())
+        assert [e.object.metadata.name for e in w.pending()] == ["fresh"]
+
+
+class TestUnbind:
+    def _bound(self, client, name="p", node="n0"):
+        client.create_pod(
+            make_pod(name).node(node).container(cpu="1").obj()
+        )
+        return client.get_pod("default", name)
+
+    def test_unbind_releases_binding(self):
+        server = APIServer()
+        client = Client(server)
+        pod = self._bound(client)
+        out = server.unbind(
+            "default", "p",
+            expect_uid=pod.metadata.uid, expect_node="n0",
+        )
+        assert out.spec.node_name == ""
+        assert out.status.phase != POD_RUNNING
+        assert out.status.start_time is None
+        # idempotent: already unbound is success, not an error
+        again = server.unbind("default", "p")
+        assert again.spec.node_name == ""
+
+    def test_acked_pod_refuses_unbind(self):
+        """The store lock settles the ack-vs-unbind race: Running wins
+        and comes back as the typed ``acked`` conflict."""
+        server = APIServer()
+        client = Client(server)
+        pod = self._bound(client)
+        client.update_pod_status(
+            "default", "p",
+            lambda p: setattr(p.status, "phase", POD_RUNNING),
+        )
+        with pytest.raises(BindConflict) as err:
+            server.unbind(
+                "default", "p",
+                expect_uid=pod.metadata.uid, expect_node="n0",
+            )
+        assert err.value.kind == "acked"
+        assert client.get_pod("default", "p").spec.node_name == "n0"
+
+    def test_uid_and_node_fences(self):
+        server = APIServer()
+        client = Client(server)
+        pod = self._bound(client)
+        with pytest.raises(BindConflict) as err:
+            server.unbind("default", "p", expect_uid="other-incarnation")
+        assert err.value.kind == "uid-mismatch"
+        with pytest.raises(BindConflict) as err:
+            server.unbind(
+                "default", "p",
+                expect_uid=pod.metadata.uid, expect_node="n7",
+            )
+        assert err.value.kind == "already-bound"
+        assert client.get_pod("default", "p").spec.node_name == "n0"
+
+
+class TestHollowNodeFleet:
+    def _env(self, num_nodes=4, **cfg):
+        server = APIServer()
+        client = Client(server)
+        names = [f"n{i}" for i in range(num_nodes)]
+        for n in names:
+            client.create_node(
+                make_node(n).capacity(cpu="8", memory="16Gi", pods=110).obj()
+            )
+        fleet = HollowNodeFleet(client, names, FleetConfig(**cfg))
+        return server, client, fleet, names
+
+    def test_pump_acks_bound_pods(self):
+        server, client, fleet, names = self._env()
+        for i in range(6):
+            client.create_pod(
+                make_pod(f"p{i}").node(names[i % 4])
+                .container(cpu="500m").obj()
+            )
+        fleet.pump()
+        pods, _ = client.list_pods()
+        assert all(p.status.phase == POD_RUNNING for p in pods)
+        assert fleet.pods_acked == 6
+        # acks are idempotent over the same incarnation
+        fleet.pump()
+        assert fleet.pods_acked == 6
+
+    def test_stale_ack_fenced_after_rebind(self):
+        """A late ack from the old node must not mark a requeued (or
+        rebound) incarnation Running: the uid/node fence inside the
+        status mutate refuses it under the store lock."""
+        server, client, fleet, names = self._env()
+        client.create_pod(
+            make_pod("p").node("n0").container(cpu="1").obj()
+        )
+        pod = client.get_pod("default", "p")
+        old_uid = pod.metadata.uid
+        # rebind-after-timeout won: the pod moved to n1
+        server.unbind("default", "p", expect_uid=old_uid, expect_node="n0")
+        server.guaranteed_update(
+            "Pod", "default", "p",
+            lambda p: setattr(p.spec, "node_name", "n1"),
+        )
+        # the old node's ack fires late
+        fleet.shards[0]._fire_ack(("default", "p", old_uid, "n0"))
+        assert fleet.stale_acks == 1
+        assert client.get_pod("default", "p").status.phase != POD_RUNNING
+
+    def test_zombie_heartbeats_but_never_acks(self):
+        server, client, fleet, names = self._env()
+        fleet.mark_zombie(["n0"])
+        client.create_pod(
+            make_pod("stuck").node("n0").container(cpu="1").obj()
+        )
+        fleet.pump()
+        fleet.heartbeat_once()
+        assert client.get_pod("default", "stuck").status.phase != POD_RUNNING
+        assert fleet.pods_acked == 0
+        assert fleet.acks_suppressed >= 1
+        # the lease still renews: only bind-ack tracking can see a zombie
+        lease = server.get("Lease", LEASE_NAMESPACE, "n0")
+        assert lease.renew_time > 0
+
+    def test_dark_node_goes_fully_silent(self):
+        server, client, fleet, names = self._env()
+        fleet.heartbeat_once()
+        first = server.get("Lease", LEASE_NAMESPACE, "n0").renew_time
+        fleet.go_dark(["n0"])
+        client.create_pod(
+            make_pod("p").node("n0").container(cpu="1").obj()
+        )
+        time.sleep(0.01)
+        fleet.pump()
+        fleet.heartbeat_once()
+        assert client.get_pod("default", "p").status.phase != POD_RUNNING
+        assert server.get("Lease", LEASE_NAMESPACE, "n0").renew_time == first
+        # the siblings kept renewing
+        assert server.get("Lease", LEASE_NAMESPACE, "n1").renew_time > 0
+
+    def test_allocatable_drift_stays_bounded(self):
+        server, client, fleet, names = self._env(
+            num_nodes=2, allocatable_drift=1.0, seed=7,
+        )
+        base = client.get_node("n0").status.allocatable[RESOURCE_PODS]
+        for _ in range(40):
+            fleet.heartbeat_once()
+        assert fleet.allocatable_drifts > 0
+        for n in names:
+            cur = client.get_node(n).status.allocatable[RESOURCE_PODS]
+            assert base - 2 <= cur <= base + 2
+
+    def test_sharding_splits_nodes(self):
+        server, client, fleet, names = self._env(num_nodes=7, shard_size=3)
+        assert [len(s.nodes) for s in fleet.shards] == [3, 3, 1]
+        assert fleet.node_names == set(names)
+
+    def test_threaded_fleet_closes_the_loop_with_scheduler(self):
+        """The closed control loop: create -> schedule -> bind -> shard
+        watch wakes -> ack -> Running, with heartbeats flowing, driven
+        by the fleet's own threads."""
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(client, informers, batch=True, max_batch=32)
+        names = [f"n{i}" for i in range(6)]
+        for n in names:
+            client.create_node(
+                make_node(n).capacity(cpu="8", memory="16Gi", pods=110).obj()
+            )
+        fleet = HollowNodeFleet(
+            client, names,
+            FleetConfig(shard_size=2, heartbeat_interval_seconds=0.2),
+        )
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        fleet.start()
+        for i in range(24):
+            client.create_pod(
+                make_pod(f"p{i}").container(cpu="500m", memory="256Mi").obj()
+            )
+        sched.start()
+        try:
+            assert _wait(
+                lambda: sum(
+                    1 for p in client.list_pods()[0]
+                    if p.status.phase == POD_RUNNING
+                ) == 24,
+                30,
+            ), "closed loop never drove all pods to Running"
+        finally:
+            sched.stop()
+            fleet.stop()
+            informers.stop()
+        assert fleet.pods_acked >= 24
+        leases, _ = server.list("Lease")
+        assert {le.metadata.name for le in leases} >= set(names)

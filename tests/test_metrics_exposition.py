@@ -197,6 +197,20 @@ class TestMetricsEndpointE2E:
         assert "scheduler_quota_parked" in body
         assert "scheduler_quota_releases_total" in body
         assert "scheduler_tenant_dominant_share" in body
+        # hollow-node / closed-bind-loop families (ISSUE 17): ack path,
+        # heartbeat plane, and the zombie-recovery arc are all
+        # registered in the default registry so a silent kubelet shows
+        # up on a dashboard before the rebind sweep fires
+        assert "scheduler_hollow_acks_total" in body
+        assert "scheduler_hollow_heartbeats_total" in body
+        assert "scheduler_bind_acks_total" in body
+        assert "scheduler_bind_ack_latency_seconds" in body
+        assert "scheduler_bind_ack_timeouts_total" in body
+        assert "scheduler_rebinds_total" in body
+        assert "scheduler_bind_ack_pending" in body
+        assert "scheduler_bind_ack_suspect_nodes_tainted_total" in body
+        assert "scheduler_node_heartbeat_lapses_total" in body
+        assert "scheduler_taint_evictions_total" in body
         # and the quantile gauge carries a real estimate post-burst
         p99 = metrics.pod_to_bind_quantile.value(q="0.99")
         assert p99 > 0.0

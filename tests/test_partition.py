@@ -7,7 +7,7 @@ import time
 
 import pytest
 
-from kubernetes_tpu.api.types import Lease, ObjectMeta
+from kubernetes_tpu.api.types import Lease, ObjectMeta, ResourceQuota
 from kubernetes_tpu.apiserver.server import (
     APIServer,
     BindConflict,
@@ -15,7 +15,9 @@ from kubernetes_tpu.apiserver.server import (
     Gone,
 )
 from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.informer import InformerFactory
 from kubernetes_tpu.config.types import PartitionConfiguration
+from kubernetes_tpu.controllers.quota import QuotaController
 from kubernetes_tpu.robustness.faults import (
     FaultInjector,
     FaultPoint,
@@ -289,6 +291,124 @@ class TestCoordinatorLeases:
         assert fenced == {
             i for i, h in enumerate(hosts) if c.node_partition(h) == k
         }
+
+
+class TestSingletonWriterElection:
+    """ISSUE 17 satellite: quota ``sync_all``'s absolute used-rewrite
+    must run in exactly ONE stack of a multi-active deployment -- the
+    stack holding the lowest live-held partition -- and fail over when
+    the elected stack's leases lapse."""
+
+    def _stacks(self, server, num_partitions=4):
+        # long lease duration: the election reads lease ground truth,
+        # and a 0.5s TTL would depose everyone mid-assert
+        cfgs = _config(
+            num_partitions=num_partitions, lease_duration_seconds=30.0,
+        )
+        out = []
+        for ident in ("s1", "s2"):
+            c = PartitionCoordinator(
+                Client(server), _FakeSched(), cfgs, ident
+            )
+            c._adopt_partition = lambda k: None
+            c._drop_partition = lambda k: None
+            out.append(c)
+        for _ in range(6):
+            for c in out:
+                c.step()
+        return out
+
+    def _depose(self, server, coord):
+        """Force-expire every lease the stack holds (crash simulation:
+        the holder stops renewing)."""
+        for k in list(coord.held):
+            server.guaranteed_update(
+                "Lease", coord.config.resource_namespace,
+                coord._lease_name(k),
+                lambda le: setattr(
+                    le, "renew_time", le.renew_time - 1e6
+                ),
+            )
+
+    def test_exactly_one_writer_and_failover(self):
+        server = APIServer()
+        c1, c2 = self._stacks(server)
+        assert sorted(list(c1.held) + list(c2.held)) == [0, 1, 2, 3]
+        elected = [
+            c for c in (c1, c2) if c.elected_singleton_writer()
+        ]
+        assert len(elected) == 1, "election must be exclusive"
+        lowest = min(list(c1.held) + list(c2.held))
+        assert lowest in elected[0].held
+        # depose the writer: the survivor takes over, the deposed
+        # stack's next fresh read flips False
+        loser = c2 if elected[0] is c1 else c1
+        self._depose(server, elected[0])
+        assert loser.elected_singleton_writer()
+        assert not elected[0].elected_singleton_writer()
+
+    def test_sync_all_runs_in_one_stack_only(self):
+        """Two full quota stacks over one apiserver: only the elected
+        writer performs the absolute ``status.used`` rewrite; the
+        bystander books ``syncs_skipped_not_writer`` and leaves the
+        object untouched -- until the writer's leases lapse and the
+        roles swap."""
+        server = APIServer()
+        c1, c2 = self._stacks(server)
+        writer = c1 if c1.elected_singleton_writer() else c2
+        bystander = c2 if writer is c1 else c1
+
+        client = Client(server)
+        client.create_resource_quota(ResourceQuota(
+            metadata=ObjectMeta(name="quota", namespace="t1"),
+            hard={"pods": 10, "cpu": 10000},
+        ))
+        for i in range(3):
+            p = (
+                make_pod(f"b{i}").node(f"node-{i}")
+                .container(cpu="100m", memory="128Mi").obj()
+            )
+            p.metadata.namespace = "t1"
+            client.create_pod(p)
+
+        stacks = {}
+        for coord in (writer, bystander):
+            inf = InformerFactory(server)
+            qc = QuotaController(coord.client, inf)
+            qc.partition_coordinator = coord
+            inf.pump()
+            stacks[coord.identity] = qc
+
+        def corrupt(q):
+            q.status.used = {"pods": 99}
+
+        server.guaranteed_update("ResourceQuota", "t1", "quota", corrupt)
+        qb = stacks[bystander.identity]
+        qb.sync_all()
+        assert qb.syncs_skipped_not_writer == 1
+        assert server.get(
+            "ResourceQuota", "t1", "quota"
+        ).status.used == {"pods": 99}, (
+            "non-elected stack must not rewrite used"
+        )
+        qa = stacks[writer.identity]
+        qa.sync_all()
+        assert qa.syncs_skipped_not_writer == 0
+        assert server.get(
+            "ResourceQuota", "t1", "quota"
+        ).status.used["pods"] == 3
+
+        # failover: the writer's leases lapse, the bystander inherits
+        # the rewrite and the deposed stack starts skipping
+        self._depose(server, writer)
+        server.guaranteed_update("ResourceQuota", "t1", "quota", corrupt)
+        qb.sync_all()
+        assert qb.syncs_skipped_not_writer == 1  # no new skip
+        assert server.get(
+            "ResourceQuota", "t1", "quota"
+        ).status.used["pods"] == 3
+        qa.sync_all()
+        assert qa.syncs_skipped_not_writer == 1
 
 
 class TestSpill:

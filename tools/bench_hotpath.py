@@ -1047,6 +1047,75 @@ def bench_watch_fanout(events: int = 20000):
     return out
 
 
+def bench_heartbeat_fanout(events: int = 5000, host_counts=(50, 200)):
+    """ISSUE-17 satellite: the per-host sharded event-log broadcast.
+    A hollow fleet runs one pod watch PER HOST. On the plain broadcast
+    log every host's cursor drains EVERY bind event and filters
+    client-side (O(events * hosts) delivered frames); the routed watch
+    keys each event by ``spec.nodeName`` and delivers it only to the
+    one host it names (O(events) total, O(interested) per event). The
+    routed drain should stay roughly FLAT as hosts grows while the
+    plain drain scales linearly with it."""
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.testing import make_pod
+
+    out = {}
+    for hosts in host_counts:
+        names = [f"h{i}" for i in range(hosts)]
+        pods = [
+            make_pod(f"hb-{i}").node(names[i % hosts])
+            .container(cpu="1m", memory="1Mi").obj()
+            for i in range(events)
+        ]
+
+        # plain broadcast: every host drains the full log and filters
+        server = APIServer(watch_history_limit=events + 16)
+        _, rv = server.list("Pod")
+        for p in pods:
+            server.create(p)
+        ws = [server.watch("Pod", since_rv=rv) for _ in range(hosts)]
+        mine = [set() for _ in range(hosts)]
+        t0 = time.perf_counter()
+        frames = 0
+        for i, w in enumerate(ws):
+            want = names[i]
+            while True:
+                evs = w.next_batch(timeout=0)
+                if not evs:
+                    break
+                frames += len(evs)
+                for ev in evs:
+                    if ev.object.spec.node_name == want:
+                        mine[i].add(ev.object.metadata.name)
+        plain_ms = (time.perf_counter() - t0) * 1000
+        assert frames == events * hosts, frames
+        assert sum(len(m) for m in mine) == events
+        for w in ws:
+            w.stop()
+
+        # routed: the server's one dict probe per event delivers each
+        # frame only to the interested host
+        server = APIServer(watch_history_limit=events + 16)
+        _, rv = server.list("Pod")
+        for p in pods:
+            server.create(p)
+        rws = [
+            server.watch_routes("Pod", {n}, since_rv=rv) for n in names
+        ]
+        t0 = time.perf_counter()
+        rframes = 0
+        for w in rws:
+            rframes += len(w.pending())
+        routed_ms = (time.perf_counter() - t0) * 1000
+        assert rframes == events, rframes
+
+        out[f"hb_fanout_{hosts}h_plain_ms"] = plain_ms
+        out[f"hb_fanout_{hosts}h_routed_ms"] = routed_ms
+        out[f"hb_fanout_{hosts}h_plain_frames"] = frames
+        out[f"hb_fanout_{hosts}h_routed_frames"] = rframes
+    return out
+
+
 def bench_ingest(pack_pods: int = 5000):
     """The ISSUE-12 ingest plane: watch-frame decode+apply events/s for
     the native C pass vs the Python twin at 10k/100k events (plus the
@@ -1407,6 +1476,7 @@ def main() -> None:
     mesh_pallas = bench_mesh_pallas(args.mesh_nodes, args.mesh_devices)
     preempt = bench_preemption_wave(args.nodes)
     fanout = bench_watch_fanout()
+    hb_fanout = bench_heartbeat_fanout()
     tenant = bench_tenant_columns()
     ingest = bench_ingest()
     trace_overhead = bench_trace_overhead()
@@ -1458,6 +1528,12 @@ def main() -> None:
         }
     )
     record.update({k: round(v, 2) for k, v in fanout.items()})
+    record.update(
+        {
+            k: (v if isinstance(v, int) else round(v, 2))
+            for k, v in hb_fanout.items()
+        }
+    )
     record.update({k: round(v, 3) for k, v in tenant.items()})
     record.update(
         {
