@@ -92,7 +92,7 @@ _INGEST_FNS = {
     name: getattr(hotpath, name, None)
     for name in (
         "ingest_decode", "ingest_apply", "ingest_stamp",
-        "pack_gather", "queue_shape",
+        "pack_gather", "queue_shape", "mirror_scatter",
     )
 }
 

@@ -1638,6 +1638,96 @@ fail:
     return NULL;
 }
 
+static PyObject *
+mirror_scatter(PyObject *self, PyObject *args)
+{
+    /* mirror_scatter(a, req, nzr, req_shadow, nzr_shadow,
+     *                rows_out, req_out, nzr_out) -> k
+     *
+     * The bind-echo -> shadow-mirror hot loop (ISSUE 18): one pass over
+     * the batch's int32 assignments compacts the placed rows into
+     * rows_out/req_out/nzr_out AND scatter-adds the per-pod demand into
+     * the int32 shadow expectation, replacing the committer's
+     * fancy-index + two np.add.at passes. Every index is validated
+     * BEFORE any buffer is mutated so a failure here can always fall
+     * back to the Python twin (scheduler/batch.py _mirror_scatter_py)
+     * without double-applying. Layout contract (all C-contiguous):
+     * a int32[b], req int32[b,r], nzr int32[b,2], req_shadow int32[n,r]
+     * (writable), nzr_shadow int32[n,2] (writable), rows_out int64[b],
+     * req_out int32[b,r], nzr_out int32[b,2]. */
+    Py_buffer a_buf, req_buf, nzr_buf, rs_buf, ns_buf;
+    Py_buffer ro_buf, qo_buf, zo_buf;
+    if (!PyArg_ParseTuple(args, "y*y*y*w*w*w*w*w*", &a_buf, &req_buf,
+                          &nzr_buf, &rs_buf, &ns_buf, &ro_buf, &qo_buf,
+                          &zo_buf))
+        return NULL;
+    PyObject *ret = NULL;
+    Py_ssize_t b = a_buf.len / 4;
+    Py_ssize_t n = ns_buf.len / 8;
+    Py_ssize_t r = (b > 0) ? req_buf.len / (4 * b) : 0;
+    if (b == 0) {
+        ret = PyLong_FromSsize_t(0);
+        goto out;
+    }
+    if (r <= 0 || req_buf.len != b * r * 4 || nzr_buf.len != b * 8 ||
+        rs_buf.len != n * r * 4 || ns_buf.len != n * 8 ||
+        ro_buf.len < b * 8 || qo_buf.len < b * r * 4 ||
+        zo_buf.len < b * 8) {
+        PyErr_SetString(PyExc_ValueError,
+                        "mirror_scatter buffer shape mismatch");
+        goto out;
+    }
+    {
+        const int32_t *a32 = (const int32_t *)a_buf.buf;
+        const int32_t *q32 = (const int32_t *)req_buf.buf;
+        const int32_t *z32 = (const int32_t *)nzr_buf.buf;
+        int32_t *rs32 = (int32_t *)rs_buf.buf;
+        int32_t *ns32 = (int32_t *)ns_buf.buf;
+        int64_t *ro64 = (int64_t *)ro_buf.buf;
+        int32_t *qo32 = (int32_t *)qo_buf.buf;
+        int32_t *zo32 = (int32_t *)zo_buf.buf;
+        /* validate-before-mutate: the twin must stay a safe retry */
+        for (Py_ssize_t i = 0; i < b; i++) {
+            int32_t v = a32[i];
+            if (v != -1 && (v < 0 || (Py_ssize_t)v >= n)) {
+                PyErr_SetString(PyExc_ValueError,
+                                "mirror_scatter assignment out of range");
+                goto out;
+            }
+        }
+        Py_ssize_t k = 0;
+        for (Py_ssize_t i = 0; i < b; i++) {
+            int32_t v = a32[i];
+            if (v == -1)
+                continue;
+            const int32_t *qrow = q32 + i * r;
+            int32_t *srow = rs32 + (Py_ssize_t)v * r;
+            int32_t *orow = qo32 + k * r;
+            for (Py_ssize_t j = 0; j < r; j++) {
+                srow[j] += qrow[j];
+                orow[j] = qrow[j];
+            }
+            ns32[2 * v] += z32[2 * i];
+            ns32[2 * v + 1] += z32[2 * i + 1];
+            zo32[2 * k] = z32[2 * i];
+            zo32[2 * k + 1] = z32[2 * i + 1];
+            ro64[k] = (int64_t)v;
+            k++;
+        }
+        ret = PyLong_FromSsize_t(k);
+    }
+out:
+    PyBuffer_Release(&a_buf);
+    PyBuffer_Release(&req_buf);
+    PyBuffer_Release(&nzr_buf);
+    PyBuffer_Release(&rs_buf);
+    PyBuffer_Release(&ns_buf);
+    PyBuffer_Release(&ro_buf);
+    PyBuffer_Release(&qo_buf);
+    PyBuffer_Release(&zo_buf);
+    return ret;
+}
+
 static PyMethodDef methods[] = {
     {"match_compiled", match_compiled, METH_VARARGS,
      "match_compiled(labels, compiled) -> bool"},
@@ -1668,6 +1758,9 @@ static PyMethodDef methods[] = {
      "pack_gather(pods, stamp, row_cache, idx, nzr, prio) -> new_keys"},
     {"queue_shape", queue_shape, METH_VARARGS,
      "queue_shape(pods) -> (keys, prios, noms)"},
+    {"mirror_scatter", mirror_scatter, METH_VARARGS,
+     "mirror_scatter(a, req, nzr, req_shadow, nzr_shadow, rows_out, "
+     "req_out, nzr_out) -> placed count k"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -54,6 +54,8 @@ from kubernetes_tpu.ops.assignment import (
     GreedyConfig,
     NO_NODE,
     apply_assignment_delta,
+    compress_carry,
+    decompress_carry,
     greedy_assign_compact,
     greedy_assign_constrained,
     sinkhorn_assign,
@@ -136,6 +138,59 @@ def _commit_gather_py(solver_infos, order, assigns, names):
         clones.append(assumed)
         hosts.append(host)
     return pis, clones, hosts
+
+
+def _mirror_scatter_py(assignments, b, req, nzr, req_shadow, nzr_shadow):
+    """Pure-Python twin of native mirror_scatter: compact the batch's
+    placed rows and scatter-add them into the shadow expectation.
+    Returns (rows [K] int64, req_rows [K, R], nzr_rows [K, 2]) or None
+    when nothing placed -- identical semantics to the C loop
+    (differentially tested in tests/test_native_mirror.py)."""
+    placed = assignments[:b] != NO_NODE
+    if not placed.any():
+        return None
+    rows_placed = assignments[:b][placed].astype(np.int64)
+    req_rows = req[:b][placed]
+    nzr_rows = nzr[:b][placed]
+    np.add.at(req_shadow, rows_placed, req_rows)
+    np.add.at(nzr_shadow, rows_placed, nzr_rows)
+    return rows_placed, req_rows, nzr_rows
+
+
+def _mirror_scatter(assignments, b, req, nzr, req_shadow, nzr_shadow):
+    """The bind-echo -> shadow-mirror hot loop: one C pass
+    (native/_hotpath.c mirror_scatter) over the batch's assignments
+    compacts the placed rows AND applies the scatter-add, replacing
+    three fancy-index materializations plus two np.add.at passes per
+    batch on the committer thread. The C side validates every index
+    BEFORE mutating, so a native failure can always fall back to the
+    twin without double-applying."""
+    from kubernetes_tpu import native as _native
+
+    fn, expected = _native.ingest_fn("mirror_scatter")
+    if fn is not None:
+        try:
+            a = np.ascontiguousarray(assignments[:b], dtype=np.int32)
+            req_b = np.ascontiguousarray(req[:b], dtype=np.int32)
+            nzr_b = np.ascontiguousarray(nzr[:b], dtype=np.int32)
+            rows_out = np.empty(b, dtype=np.int64)
+            req_out = np.empty((b, req_b.shape[1]), dtype=np.int32)
+            nzr_out = np.empty((b, 2), dtype=np.int32)
+            k = fn(
+                a, req_b, nzr_b, req_shadow, nzr_shadow,
+                rows_out, req_out, nzr_out,
+            )
+            if k == 0:
+                return None
+            return rows_out[:k], req_out[:k], nzr_out[:k]
+        except Exception:
+            logger.exception("native mirror_scatter failed")
+            metrics.ingest_native_fallbacks.inc(site="mirror-scatter")
+    elif expected:
+        metrics.ingest_native_fallbacks.inc(site="mirror-scatter")
+    return _mirror_scatter_py(
+        assignments, b, req, nzr, req_shadow, nzr_shadow
+    )
 
 
 class _EagerDownload:
@@ -271,9 +326,28 @@ DELTA_ROW_BUCKET = 64
 _SHADOW_RING_CAP = MAX_INFLIGHT + 2
 
 
+#: int16 engage ceiling for the compressed carry: resident max + batch
+#: load + in-flight load must stay under this (a guard band below 32767
+#: absorbs row patches that land between the gate read and the solve)
+_CARRY_COMPRESS_CEILING = 24576
+
+
+def _batch_load16(req, nzr, b) -> int:
+    """Worst-case per-column load this batch can add to any node row
+    (every pod landing on one node): the range gate's per-dispatch
+    term."""
+    if not b:
+        return 0
+    return max(
+        int(req[:b].sum(axis=0, dtype=np.int64).max(initial=0)),
+        int(nzr[:b].sum(axis=0, dtype=np.int64).max(initial=0)),
+    )
+
+
 def _delta_slot_pieces(
     n_cap, r_dims, fix_rows=None, alloc_rows=None,
     node_requested=None, node_nzr=None, allocatable=None, valid=None,
+    compress=False,
 ):
     """The fixed `DELTA_ROW_BUCKET`-sized (indices, rows) scatter slots
     every steady-state dispatch carries in the single upload buffer.
@@ -287,10 +361,16 @@ def _delta_slot_pieces(
     ``svalid`` rides with the alloc scatter: membership churn retires /
     claims row slots in place, so the patched rows must also flip the
     device-resident valid mask (a retired slot with alloc zeroed is
-    still choosable by a zero-request pod unless valid drops)."""
+    still choosable by a zero-request pod unless valid drops).
+
+    ``compress`` ships the req/nzr delta rows packed int16 (the 'h'
+    layout kind) -- only the dispatch gate engages it, and only when
+    the row content is provably in range; the index/alloc slots stay
+    int32 (allocatable KiB routinely exceeds int16)."""
+    row_dt = np.int16 if compress else np.int32
     didx = np.full(DELTA_ROW_BUCKET, n_cap, dtype=np.int32)
-    dreq = np.zeros((DELTA_ROW_BUCKET, r_dims), dtype=np.int32)
-    dnzr = np.zeros((DELTA_ROW_BUCKET, 2), dtype=np.int32)
+    dreq = np.zeros((DELTA_ROW_BUCKET, r_dims), dtype=row_dt)
+    dnzr = np.zeros((DELTA_ROW_BUCKET, 2), dtype=row_dt)
     sidx = np.full(DELTA_ROW_BUCKET, n_cap, dtype=np.int32)
     salloc = np.zeros((DELTA_ROW_BUCKET, r_dims), dtype=np.int32)
     svalid = np.zeros(DELTA_ROW_BUCKET, dtype=np.int32)
@@ -592,6 +672,21 @@ class BatchScheduler(Scheduler):
         # (and metered into device_rebuild_ms) when the next jitted
         # solve lands on fully re-uploaded state
         self._device_lost_at: Optional[float] = None
+        # -- pipelined speculative dispatch (ISSUE 18) --------------------
+        # in-flight depth knob: the bench's serial arm pins 1 so the
+        # pipelined/serial comparison runs the same code path
+        self.max_inflight = MAX_INFLIGHT
+        self.speculative_launches = 0
+        self.speculative_rewinds = 0
+        # range-gated int16 carry compression (single-device basic
+        # solves): engaged per dispatch while every resident column sum
+        # provably stays inside the int16 guard band, so the narrowed
+        # carry is bit-exact. KTPU_CARRY_COMPRESS=0 pins the int32
+        # carry (the A/B knob).
+        self.carry_compress_enabled = (
+            mesh is None
+            and os.environ.get("KTPU_CARRY_COMPRESS", "1") != "0"
+        )
 
     # -- one batch ----------------------------------------------------------
 
@@ -894,6 +989,45 @@ class BatchScheduler(Scheduler):
                 if not p.get("mirrored"):
                     return p
         return None
+
+    def _unmirrored_exists(self) -> bool:
+        """Any dispatched batch whose shadow mirror has NOT landed yet?
+        Once every pending record is mirrored the device carry equals
+        the host shadow exactly (each dispatch rebinds the carry refs
+        and the mirror is the only shadow writer), so the handshake can
+        negotiate row-exact repairs with commits still in flight -- the
+        speculative chain's cheap-rewind precondition."""
+        with self._pending_cv:
+            return any(not p.get("mirrored") for p in self._pending_q)
+
+    def _inflight_load16(self) -> int:
+        """Worst-case column load of every dispatched-but-unmirrored
+        batch: their deltas live in the device carry but not yet in the
+        shadow the compression range gate reads."""
+        with self._pending_cv:
+            return sum(
+                int(p.get("load16", 0))
+                for p in self._pending_q
+                if not p.get("mirrored")
+            )
+
+    def _await_mirrors(self, timeout: float = 30.0) -> bool:
+        """Block until every in-flight batch has mirrored its deltas
+        into the shadow -- far cheaper than ``_drain_pending``, which
+        also waits out the bind/commit API transactions. The committer
+        notifies ``_pending_cv`` right after each mirror. Returns False
+        on timeout or when no committer is running (the caller falls
+        back to a full drain)."""
+        if self._committer is None:
+            return not self._pending_exists()
+        deadline = time.monotonic() + timeout
+        with self._pending_cv:
+            while any(not p.get("mirrored") for p in self._pending_q):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._pending_cv.wait(min(left, 0.5))
+        return True
 
     def _device_tiers(
         self, mode: str, b: int, n_cap: int, r_dims: int, u_rows: int
@@ -1290,8 +1424,15 @@ class BatchScheduler(Scheduler):
             return
         self._ensure_committer()
         with self._pending_cv:
-            while len(self._pending_q) >= MAX_INFLIGHT:
+            while len(self._pending_q) >= self.max_inflight:
                 self._pending_cv.wait()
+            if self._pending_q:
+                # the solve launched against the shadow-EXPECTED state
+                # of still-uncommitted batches: a speculative link in
+                # the chain (a commit divergence rewinds it via the
+                # row-patch path instead of a drain)
+                self.speculative_launches += 1
+                metrics.speculative_launches.inc()
             self._pending_q.append(pending)
             self._pending_cv.notify_all()
 
@@ -1402,7 +1543,7 @@ class BatchScheduler(Scheduler):
 
     def _negotiate_device_state(
         self, nt, node_requested, node_nzr, overlaid,
-        allow_scatter, pending_exists,
+        allow_scatter, pending_exists, unmirrored_exists=None,
     ):
         """Decide how this dispatch's node state reaches the device and
         reconcile the handshake bookkeeping. Returns None when in-flight
@@ -1425,7 +1566,18 @@ class BatchScheduler(Scheduler):
         (each delta row lands on exactly one node shard);
         ``allow_scatter=False`` is the KTPU_MESH_DELTA=0 escape hatch
         that restores the PR-5 counted full-upload fallback.
+
+        ``unmirrored_exists`` is the speculative-chain relaxation: the
+        membership-adopt and scatter-fix paths only need the device
+        carry to EQUAL the shadow, which holds as soon as every
+        in-flight batch has mirrored -- commits may still be running.
+        Only the full-upload path (which takes HOST truth as the new
+        carry, so every placement must have landed in the cache) still
+        gates on ``pending_exists``. Defaults to ``pending_exists``
+        (the conservative pre-pipelining behavior) when not given.
         """
+        if unmirrored_exists is None:
+            unmirrored_exists = pending_exists
         ds = self._dev
         d = nt.delta
         empty = np.zeros(0, dtype=np.int64)
@@ -1450,13 +1602,15 @@ class BatchScheduler(Scheduler):
                 member = self.tensor_cache.membership_rows_since(
                     ds.validated_epoch
                 )
-                if member.size and allow_scatter and pending_exists:
-                    # churned slots cannot be reconciled while batches
-                    # are in flight: a pending batch may have placed
+                if member.size and allow_scatter and unmirrored_exists:
+                    # churned slots cannot be reconciled while an
+                    # UNMIRRORED batch is in flight: it may have placed
                     # onto a now-retired slot, and adopting host truth
-                    # under it would desync the mirror. Land everything,
-                    # then redo the dispatch (the scatter then applies
-                    # cleanly -- no upload, no divergence).
+                    # under it would desync the mirror. Once every
+                    # in-flight batch has mirrored the carry equals the
+                    # shadow and the adopt+scatter is exact, so the
+                    # caller only needs to await mirrors (cheap), not a
+                    # full drain.
                     return None
                 nonmember = changed
                 if member.size:
@@ -1501,11 +1655,15 @@ class BatchScheduler(Scheduler):
                     and not static_full
                     and div_rows.size <= DELTA_ROW_BUCKET
                     and keep == 0  # no pending delta touches a div row
-                    and not pending_exists
+                    and not unmirrored_exists
                 ):
-                    # resolvable in place: with nothing in flight the
-                    # carry equals the shadow, so setting the divergent
-                    # rows to host truth on device is exact
+                    # resolvable in place: with every in-flight batch
+                    # mirrored the carry equals the shadow, so setting
+                    # the divergent rows to host truth on device is
+                    # exact even with commits still running -- the
+                    # speculative chain's cheap rewind (a bind
+                    # conflict / quota refund / conflict-requeue
+                    # re-solves only against these patched rows)
                     fix_rows = div_rows
                 else:
                     carry = "dead"  # resolve by full upload (or drain)
@@ -1542,6 +1700,14 @@ class BatchScheduler(Scheduler):
                     ds.nzr_shadow[fix_rows] = node_nzr[fix_rows]
                     self.carry_divergences += 1
                     metrics.carry_divergences.inc()
+                    if pending_exists:
+                        # the expected deltas diverged under an active
+                        # speculative chain and the carry was repaired
+                        # in place: the cheap rewind, not a drain
+                        self.speculative_rewinds += 1
+                        metrics.speculative_rewinds.inc(
+                            reason="row_patch"
+                        )
                 if member.size:
                     self.membership_row_patches += int(member.size)
                 ds.validated_epoch = d.epoch
@@ -1579,6 +1745,55 @@ class BatchScheduler(Scheduler):
                 "sidx": empty,
                 "member": 0,
             }
+
+    def _compress_decision(
+        self, neg, constrained, overlaid, node_requested, node_nzr,
+        batch_load16,
+    ) -> bool:
+        """Engage the int16 carry for THIS dispatch only when it is
+        provably lossless: the largest resident column value (shadow
+        maxima post-negotiate, or the upload source on a cold path)
+        plus this batch's and every unmirrored in-flight batch's
+        worst-case column load must stay inside the int16 guard band.
+        Converts the resident carry on a mode flip (one tiny on-device
+        kernel each way, both warmed) and books the disengage reasons.
+        Constrained/overlaid dispatches always run uncompressed -- the
+        constrained ladder keeps its one-int32-signature contract."""
+        ds = self._dev
+        resident16 = (
+            ds.req_dev is not None
+            and getattr(ds.req_dev, "dtype", None) == jnp.int16
+        )
+        want = not constrained and not overlaid
+        if want:
+            with self._shadow_lock:
+                if neg["carry_ok"] and ds.req_shadow is not None:
+                    resident = max(
+                        int(ds.req_shadow.max(initial=0)),
+                        int(ds.nzr_shadow.max(initial=0)),
+                    )
+                else:
+                    resident = max(
+                        int(node_requested.max(initial=0)),
+                        int(node_nzr.max(initial=0)),
+                    )
+            load = batch_load16 + self._inflight_load16()
+            want = resident + load <= _CARRY_COMPRESS_CEILING
+            if not want and resident16:
+                metrics.carry_compress_disengages.inc(reason="range")
+        elif resident16:
+            metrics.carry_compress_disengages.inc(reason="mode")
+        if neg["carry_ok"] and ds.req_dev is not None:
+            if want and not resident16:
+                ds.req_dev, ds.nzr_dev = compress_carry(
+                    ds.req_dev, ds.nzr_dev
+                )
+            elif not want and resident16:
+                ds.req_dev, ds.nzr_dev = decompress_carry(
+                    ds.req_dev, ds.nzr_dev
+                )
+        metrics.carry_compressed.set(1.0 if want else 0.0)
+        return want
 
     def _dispatch_solve(
         self,
@@ -2052,12 +2267,32 @@ class BatchScheduler(Scheduler):
             nt, node_requested, node_nzr, overlaid,
             allow_scatter=self.mesh is None or self.mesh_delta,
             pending_exists=self._pending_exists(),
+            unmirrored_exists=self._unmirrored_exists(),
         )
+        if neg is None and self._await_mirrors():
+            # the blocked path (membership adopt / divergence repair)
+            # only needs the carry to equal the shadow, which holds the
+            # moment every in-flight batch has MIRRORED -- so wait for
+            # the mirrors (the committer signals them; typically a few
+            # ms) and renegotiate before paying a full pipeline drain
+            retry = self._negotiate_device_state(
+                nt, node_requested, node_nzr, overlaid,
+                allow_scatter=self.mesh is None or self.mesh_delta,
+                pending_exists=self._pending_exists(),
+                unmirrored_exists=False,
+            )
+            if retry is not None:
+                self.speculative_rewinds += 1
+                metrics.speculative_rewinds.inc(reason="mirror_wait")
+            neg = retry
         if neg is None:
             # the handshake needs an upload but the device carry is ahead
             # of the host by the in-flight batches (node churn, bind
             # failure, dead carry): land them, then redo this dispatch
             # from the fresh host state
+            if self._pending_exists():
+                self.speculative_rewinds += 1
+                metrics.speculative_rewinds.inc(reason="drain")
             self._drain_pending()
             span.finish(routed="drain_redispatch")
             return self._dispatch_solve(
@@ -2074,6 +2309,16 @@ class BatchScheduler(Scheduler):
             ),
             delta_rows=int(neg["didx"].size + neg["sidx"].size),
         )
+        compress = False
+        batch_load16 = 0
+        if self.carry_compress_enabled:
+            batch_load16 = _batch_load16(req, nzr, b)
+            compress = self._compress_decision(
+                neg, constrained, overlaid, node_requested, node_nzr,
+                batch_load16,
+            )
+            if compress:
+                span.note(compressed=True)
         if self.mesh is None or self.mesh_delta:
             # single-buffer upload: over the serving link every device_put
             # operand pays its own round trip (~40-90ms each); the whole
@@ -2100,8 +2345,17 @@ class BatchScheduler(Scheduler):
                 pieces.append(("alloc", nt.allocatable))
                 pieces.append(("valid", nt.valid.astype(np.int32)))
             if not carry_ok:
-                pieces.append(("req_state", node_requested))
-                pieces.append(("nzr_state", node_nzr))
+                if compress:
+                    # cold/refresh upload with the gate engaged: the
+                    # carry ships packed int16 ('h' kind, half the
+                    # link bytes) and stays int16 on device
+                    pieces.append(
+                        ("req_state", node_requested.astype(np.int16))
+                    )
+                    pieces.append(("nzr_state", node_nzr.astype(np.int16)))
+                else:
+                    pieces.append(("req_state", node_requested))
+                    pieces.append(("nzr_state", node_nzr))
             else:
                 # steady state: the resident [N, R] tensors stay on
                 # device; only the changed-row scatter rides the buffer
@@ -2110,6 +2364,7 @@ class BatchScheduler(Scheduler):
                     fix_rows=neg["didx"], alloc_rows=neg["sidx"],
                     node_requested=node_requested, node_nzr=node_nzr,
                     allocatable=nt.allocatable, valid=nt.valid,
+                    compress=compress,
                 )
             if constrained:
                 from kubernetes_tpu.ops.assignment import ConstPiece
@@ -2180,6 +2435,7 @@ class BatchScheduler(Scheduler):
                     mode=solve_mode,
                     allow_pallas=allow_pallas,
                     mesh=self.mesh,
+                    compress=compress,
                 )
 
             def run_host_greedy():
@@ -2340,6 +2596,14 @@ class BatchScheduler(Scheduler):
                     metrics.state_uploads.inc()
                     if self._device_lost_at is not None:
                         self._note_device_rebuilt()
+                if compress:
+                    # link bytes the int16 packing kept off the wire
+                    # this dispatch (half of what the int32 form ships)
+                    metrics.carry_compress_bytes_saved.inc(
+                        2 * DELTA_ROW_BUCKET * (nt.dims.num_dims + 2)
+                        if carry_ok
+                        else 2 * (node_requested.size + node_nzr.size)
+                    )
                 if not static_ok:
                     ds.alloc_dev, ds.valid_dev = alloc_out, valid_out
                 elif neg["sidx"].size:
@@ -2378,6 +2642,7 @@ class BatchScheduler(Scheduler):
                 "solve_timer": solve_timer,
                 "mask_rows": mask_rows,
                 "mask_index_solved": midx,
+                "load16": batch_load16,
             }
 
         # -- KTPU_MESH_DELTA=0 fallback: the PR-5 mesh path ----------------
@@ -3198,16 +3463,20 @@ class BatchScheduler(Scheduler):
                 # handshake subtracts it while the host cache still
                 # trails this commit. O(B*R) in-place -- the retired
                 # shadow_gens ring copied the full [N, R] per batch.
-                placed = assignments[:b] != NO_NODE
-                if placed.any():
-                    rows_placed = assignments[:b][placed].astype(np.int64)
-                    req_rows = p["req"][:b][placed]
-                    nzr_rows = p["nzr"][:b][placed]
-                    np.add.at(ds.req_shadow, rows_placed, req_rows)
-                    np.add.at(ds.nzr_shadow, rows_placed, nzr_rows)
-                    ds.pending_deltas.append(
-                        (rows_placed, req_rows, nzr_rows)
-                    )
+                # The compact+scatter hot loop runs in native
+                # _hotpath.c (mirror_scatter; numpy twin behind
+                # KTPU_NATIVE_INGEST=0, differentially tested).
+                delta = _mirror_scatter(
+                    assignments, b, p["req"], p["nzr"],
+                    ds.req_shadow, ds.nzr_shadow,
+                )
+                if delta is not None:
+                    ds.pending_deltas.append(delta)
+        # wake dispatchers parked in _await_mirrors at MIRROR time: the
+        # commit/bind API transactions below can be hundreds of ms away,
+        # and the speculative renegotiation only needs the mirror
+        with self._pending_cv:
+            self._pending_cv.notify_all()
         if inj is not None and inj.should_fire(FaultPoint.CARRY_CORRUPT):
             self._corrupt_carry_row()
         t_commit = time.perf_counter()
@@ -3222,6 +3491,15 @@ class BatchScheduler(Scheduler):
         dt_commit = time.perf_counter() - t_commit
         self._stage_add("commit", dt_commit)
         fspan.stage("commit", dt_commit, t0=t_commit)
+        if flightrecorder.trace_active():
+            # the committer's own named track: in the Perfetto artifact
+            # this span overlaps the "device" track's next solve span,
+            # making the solve/commit pipeline overlap visible
+            flightrecorder.trace_span(
+                f"commit b={b}", t_commit, dt_commit,
+                track="committer",
+                args={"batch": getattr(fspan, "batch_id", None)},
+            )
         fspan.finish()
         if (
             self._prewarm_next_commit
@@ -4179,6 +4457,47 @@ class BatchScheduler(Scheduler):
                 ))
                 samples.append(time.perf_counter() - t0)
             self.pad_solve_seconds[padded] = sorted(samples)[1]
+            if self.carry_compress_enabled:
+                # compressed-carry signatures (ISSUE 18): the range
+                # gate can engage/disengage mid-run, so the int16
+                # variants of the cold/refresh/steady basic layouts --
+                # plus the on-device convert kernels the mode flips run
+                # -- must all be warm, or the first engage pays a
+                # mid-run compile the jit-cache watchdog would flag
+                carry16 = [
+                    ("req_state", np.zeros((n, r), dtype=np.int16)),
+                    ("nzr_state", np.zeros((n, 2), dtype=np.int16)),
+                ]
+                delta16 = _delta_slot_pieces(n, r, compress=True)
+                cold16 = solve_packed(
+                    base + static_pieces + carry16, None, None, None,
+                    None, config=self.solver_config,
+                    mode=self.solver_mode, compress=True,
+                )
+                jax.block_until_ready(cold16)
+                refresh16 = solve_packed(
+                    base + carry16, alloc_d, valid_d, None, None,
+                    config=self.solver_config, mode=self.solver_mode,
+                    compress=True,
+                )
+                jax.block_until_ready(refresh16)
+                _, req16, nzr16, _, _ = refresh16
+                steady16 = solve_packed(
+                    base + delta16, alloc_d, valid_d, req16, nzr16,
+                    config=self.solver_config, mode=self.solver_mode,
+                    compress=True,
+                )
+                jax.block_until_ready(steady16)
+                jax.block_until_ready(compress_carry(req_d, nzr_d))
+                jax.block_until_ready(decompress_carry(req16, nzr16))
+                # the host-greedy tier's carry keep-warm with an int16
+                # resident carry (dtype-preserving delta apply)
+                jax.block_until_ready(apply_assignment_delta(
+                    req16, nzr16,
+                    np.full(padded, NO_NODE, dtype=np.int32),
+                    np.zeros((padded, r), dtype=np.int32),
+                    np.zeros((padded, 2), dtype=np.int32),
+                ))
         if not full:
             # extra (latency-rung) pads warm the basic path only
             return

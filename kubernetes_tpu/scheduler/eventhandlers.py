@@ -70,6 +70,20 @@ def add_all_event_handlers(
     # so the DRF shares stay honest without a second watch
     note_bound = getattr(sched, "note_pods_bound", None)
     note_unbound = getattr(sched, "note_pods_unbound", None)
+    # multi-active residual 7(a): bound pods on FOREIGN-partition nodes
+    # never enter this stack's cache, but their bind echoes must still
+    # fold into the DRF shares so dominant shares are cluster-wide (the
+    # tracker dedups per uid, so re-echoes are free)
+    note_node_cap = getattr(sched, "note_node_capacity", None)
+    note_node_gone = getattr(sched, "note_node_gone", None)
+
+    def _note_foreign_bound(pod: Pod) -> None:
+        if note_bound is not None:
+            note_bound([pod])
+
+    def _note_foreign_unbound(pod: Pod) -> None:
+        if note_unbound is not None:
+            note_unbound([pod])
     # bind-ack tracker hooks (scheduler/bindack.py): cache-side frames
     # carry the pod-Running ack transition and the gone signals the
     # ledger consumes -- same watch, no second stream
@@ -205,6 +219,13 @@ def add_all_event_handlers(
             add_pod_to_cache(new)
         elif old_a and not new_a:
             delete_pod_from_cache(old)
+        elif _assigned(new):
+            # bound into a foreign partition: fold into the DRF shares
+            # (uid-deduped) even though the cache never sees it
+            _note_foreign_bound(new)
+        elif old is not None and _assigned(old) and not old_a:
+            # a foreign-bound pod released: retire its share
+            _note_foreign_unbound(old)
         new_q = not _assigned(new) and _responsible_for_pod(sched, new)
         old_q = (
             old is not None
@@ -222,6 +243,8 @@ def add_all_event_handlers(
         if _assigned(pod):
             if _cache_side(sched, pod):
                 add_pod_to_cache(pod)
+            else:
+                _note_foreign_bound(pod)
         elif _responsible_for_pod(sched, pod):
             add_pod_to_queue(pod)
 
@@ -229,6 +252,8 @@ def add_all_event_handlers(
         if _assigned(pod):
             if _cache_side(sched, pod):
                 delete_pod_from_cache(pod)
+            else:
+                _note_foreign_unbound(pod)
         elif _responsible_for_pod(sched, pod):
             delete_pod_from_queue(pod)
 
@@ -282,7 +307,9 @@ def add_all_event_handlers(
                 elif new_bound:
                     # bound into a foreign partition: not ours on either
                     # side, but a pod WE queued must still leave the
-                    # queue (the sibling stack won it)
+                    # queue (the sibling stack won it) -- and its bind
+                    # echo still folds into the cluster-wide DRF shares
+                    _note_foreign_bound(new)
                     if old is not None and (
                         old.spec.scheduler_name in profiles
                     ):
@@ -291,6 +318,10 @@ def add_all_event_handlers(
                         else:
                             queue_runs.append(("dels", [old]))
                 else:
+                    if old is not None and bool(old.spec.node_name):
+                        # foreign-bound pod released back to pending:
+                        # retire its cluster-wide share
+                        _note_foreign_unbound(old)
                     old_q = old is not None and _responsible_for_pod(
                         sched, old
                     )
@@ -318,7 +349,11 @@ def add_all_event_handlers(
                         cache_runs[-1][1].append(new)
                     else:
                         cache_runs.append(("adds", [new]))
-                elif not new_bound and _responsible_for_pod(sched, new):
+                elif new_bound:
+                    # foreign-partition bound pod (relist or sibling
+                    # commit): shares only, never cache or queue
+                    _note_foreign_bound(new)
+                elif _responsible_for_pod(sched, new):
                     if new.metadata.labels.get(POD_GROUP_LABEL):
                         # gang sibling wakeups take the per-event path
                         queue_runs.append(("add_one", new))
@@ -332,9 +367,9 @@ def add_all_event_handlers(
                         cache_runs[-1][1].append(new)
                     else:
                         cache_runs.append(("dels", [new]))
-                elif not new_bound and (
-                    new.spec.scheduler_name in profiles
-                ):
+                elif new_bound:
+                    _note_foreign_unbound(new)
+                elif new.spec.scheduler_name in profiles:
                     queue_runs.append(("del_one", new))
 
         # cache phase (whole frame), then queue phase
@@ -427,12 +462,18 @@ def add_all_event_handlers(
         return coord is None or coord.owns_node_obj(node)
 
     def add_node(node: Node) -> None:
+        # capacity feed runs BEFORE the ownership gate: the DRF
+        # denominator is the whole cluster, not this stack's slice
+        if note_node_cap is not None:
+            note_node_cap(node)
         if not _node_ours(node):
             return
         sched.cache.add_node(node)
         sched.queue.move_all_to_active_or_backoff_queue(events.NodeAdd)
 
     def update_node(old: Node, new: Node) -> None:
+        if note_node_cap is not None:
+            note_node_cap(new)
         if not _node_ours(new):
             return
         sched.cache.update_node(old, new)
@@ -441,6 +482,8 @@ def add_all_event_handlers(
             sched.queue.move_all_to_active_or_backoff_queue(event)
 
     def delete_node(node: Node) -> None:
+        if note_node_gone is not None:
+            note_node_gone(node.metadata.name)
         coord = sched.partition_coordinator
         if coord is not None and not coord.owns_node(node.metadata.name):
             return

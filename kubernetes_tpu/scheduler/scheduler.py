@@ -272,6 +272,19 @@ class Scheduler:
         if tt is not None:
             tt.note_unbound(pods)
 
+    def note_node_capacity(self, node) -> None:
+        """Node informer feed, ungated by partition ownership: the DRF
+        capacity denominator stays cluster-wide in multi-active mode
+        (ISSUE 18, residual 7(a))."""
+        tt = self.tenant_shares
+        if tt is not None:
+            tt.note_node_capacity(node)
+
+    def note_node_gone(self, name: str) -> None:
+        tt = self.tenant_shares
+        if tt is not None:
+            tt.note_node_gone(name)
+
     # -- assume (scheduler.go:474) ------------------------------------------
 
     def assume(self, assumed: Pod, host: str) -> None:

@@ -60,24 +60,64 @@ def _pod_cpu_mem(pod: Pod) -> Tuple[int, int]:
     return req.get(RESOURCE_CPU, 0), -(-req.get(RESOURCE_MEMORY, 0) // 1024)
 
 
+def _node_cpu_mem(node) -> Tuple[int, int]:
+    """(milliCPU, memory KiB) of a node's allocatable, in the SAME units
+    the node tensor packs (memory floored to KiB) so the cluster-wide
+    capacity sum and the slice tensor sum agree on a single stack."""
+    alloc = node.status.allocatable
+    return alloc.get(RESOURCE_CPU, 0), alloc.get(RESOURCE_MEMORY, 0) // 1024
+
+
 class TenantShareTracker:
     """Per-tenant (cpu, memKiB) usage + O(1) dominant-share reads.
     Thread-safe: informer frames write (note_bound/note_unbound) while
-    the dispatcher reads shares per batch."""
+    the dispatcher reads shares per batch.
+
+    Multi-active (ISSUE 18, residual 7(a)): usage and capacity are
+    CLUSTER-wide, not per-slice. The informer's bind echoes include
+    sibling stacks' commits (the event handlers route bound pods on
+    foreign-partition nodes here even though the partitioned cache drops
+    them), deduplicated per pod UID so relist + MODIFIED re-echoes of
+    the same bind never double-count; and the node informer feeds every
+    node's allocatable BEFORE the partition ownership gate, so the
+    dominant-share denominator is the whole cluster, not the N/P rows
+    this stack's tensor carries."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._used: Dict[str, List[int]] = {}  # ns -> [cpu, memKiB]
+        # uid -> (ns, cpu, memKiB): the exactly-once ledger. unbind
+        # subtracts what bind ADDED (the recorded vector), immune to a
+        # pod whose requests mutated between the two echoes
+        self._seen: Dict[str, Tuple[str, int, int]] = {}
         self._cap_cpu = 0
         self._cap_mem = 0
         self._cap_epoch = -1
+        # cluster-wide capacity from the (ungated) node informer feed;
+        # overrides the per-slice tensor sum when populated
+        self._node_caps: Dict[str, Tuple[int, int]] = {}
+        self._caps_dirty = False
 
     # -- capacity (refreshed from the packed node tensor at dispatch) ------
 
     def refresh_capacity(self, nt) -> None:
         """Two int column sums over ``nt.allocatable`` -- cached per
         tensor-cache epoch so steady dispatches against an unchanged
-        cluster skip even that."""
+        cluster skip even that. When the node-informer capacity feed is
+        live (``note_node_capacity``), its cluster-wide sum wins over
+        the slice tensor: a partitioned stack's tensor is only N/P
+        rows, and dividing by a slice inflates every share P-fold."""
+        with self._lock:
+            if self._node_caps:
+                if self._caps_dirty:
+                    self._cap_cpu = sum(
+                        c for c, _ in self._node_caps.values()
+                    )
+                    self._cap_mem = sum(
+                        m for _, m in self._node_caps.values()
+                    )
+                    self._caps_dirty = False
+                return
         delta = getattr(nt, "delta", None)
         epoch = delta.epoch if delta is not None else -1
         if epoch == self._cap_epoch and epoch >= 0:
@@ -95,15 +135,38 @@ class TenantShareTracker:
             self._cap_cpu = int(cpu_milli)
             self._cap_mem = int(mem_kib)
 
+    def note_node_capacity(self, node) -> None:
+        """Node add/update from the informer, BEFORE the partition
+        ownership gate -- every stack sees every node, so the DRF
+        denominator is cluster capacity in multi-active mode too."""
+        cpu, mem = _node_cpu_mem(node)
+        with self._lock:
+            prev = self._node_caps.get(node.metadata.name)
+            if prev == (cpu, mem):
+                return
+            self._node_caps[node.metadata.name] = (cpu, mem)
+            self._caps_dirty = True
+
+    def note_node_gone(self, name: str) -> None:
+        with self._lock:
+            if self._node_caps.pop(name, None) is not None:
+                self._caps_dirty = True
+
     # -- incremental usage (the committer's bind echoes) --------------------
 
     def note_bound(self, pods: List[Pod]) -> None:
         with self._lock:
             for pod in pods:
+                uid = pod.metadata.uid
+                if uid and uid in self._seen:
+                    continue  # relist / re-echo of a counted bind
                 cpu, mem = _pod_cpu_mem(pod)
-                u = self._used.get(pod.metadata.namespace)
+                ns = pod.metadata.namespace
+                if uid:
+                    self._seen[uid] = (ns, cpu, mem)
+                u = self._used.get(ns)
                 if u is None:
-                    self._used[pod.metadata.namespace] = [cpu, mem]
+                    self._used[ns] = [cpu, mem]
                 else:
                     u[0] += cpu
                     u[1] += mem
@@ -111,14 +174,21 @@ class TenantShareTracker:
     def note_unbound(self, pods: List[Pod]) -> None:
         with self._lock:
             for pod in pods:
-                u = self._used.get(pod.metadata.namespace)
+                rec = self._seen.pop(pod.metadata.uid or "", None)
+                if rec is not None:
+                    ns, cpu, mem = rec
+                else:
+                    # legacy direct callers (no prior note_bound ledger
+                    # entry): recompute from the pod itself
+                    ns = pod.metadata.namespace
+                    cpu, mem = _pod_cpu_mem(pod)
+                u = self._used.get(ns)
                 if u is None:
                     continue
-                cpu, mem = _pod_cpu_mem(pod)
                 u[0] = max(0, u[0] - cpu)
                 u[1] = max(0, u[1] - mem)
                 if u[0] == 0 and u[1] == 0:
-                    del self._used[pod.metadata.namespace]
+                    del self._used[ns]
 
     # -- reads ---------------------------------------------------------------
 

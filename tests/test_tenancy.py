@@ -777,3 +777,132 @@ class TestLegacyMeshCrashLoop:
         finally:
             sched.stop()
             informers.stop()
+
+
+class _HalfCoord:
+    """Stub partition coordinator owning an explicit node set (queue-
+    side responsibility stays open: these tests only exercise the
+    cache/tenancy side of the partition gates)."""
+
+    def __init__(self, owned):
+        self.owned = set(owned)
+
+    def wants_pod(self, pod):
+        return True
+
+    def owns_node(self, name):
+        return name in self.owned
+
+    def owns_node_obj(self, node):
+        return node.metadata.name in self.owned
+
+
+class TestClusterWideShares:
+    """Residual 7(a) (ISSUE 18): partitioned-mode DRF dominant shares
+    fold sibling stacks' bind echoes (the cache-side echo path sees
+    them even though the partitioned cache drops them) and divide by
+    CLUSTER capacity, not the stack's N/P-row slice."""
+
+    def _stack(self, server, owned):
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(client, informers, batch=True, max_batch=16)
+        sched.partition_coordinator = _HalfCoord(owned)
+        arm_tenancy(sched, client, informers, quota=False)
+        return client, informers, sched
+
+    def test_foreign_bind_echo_folds_into_shares(self):
+        server = APIServer()
+        client, informers, sched = self._stack(server, {"node-0"})
+        try:
+            for i in range(2):
+                client.create_node(
+                    make_node(f"node-{i}")
+                    .capacity(cpu="16", memory="32Gi").obj()
+                )
+            # our commit on the owned node + a sibling stack's commit on
+            # the foreign node, arriving as plain bind echoes
+            ours = _pod_in("tenant-a", "p-ours", cpu="1000m")
+            ours.spec.node_name = "node-0"
+            theirs = _pod_in("tenant-b", "p-theirs", cpu="1000m")
+            theirs.spec.node_name = "node-1"
+            client.create_pod(ours)
+            client.create_pod(theirs)
+            informers.pump()
+            tt = sched.tenant_shares
+            tt.refresh_capacity(None)  # node feed wins; nt unused
+            used, cap_cpu, cap_mem = tt.usage_and_caps(
+                ["tenant-a", "tenant-b"]
+            )
+            assert used["tenant-a"][0] == 1000
+            assert used["tenant-b"][0] == 1000, (
+                "sibling-stack bind echo must fold into the shares"
+            )
+            # denominator is BOTH nodes, not the owned slice
+            assert cap_cpu == 32000
+            assert abs(tt.share("tenant-b") - 1000 / 32000) < 1e-9
+            # the foreign pod must NOT have entered the partitioned cache
+            assert "node-1" not in sched.cache._nodes
+        finally:
+            sched.stop()
+            informers.stop()
+
+    def test_uid_double_echo_dedup_and_unbind_retires(self):
+        tt = TenantShareTracker()
+        tt.set_capacity(10_000, 1 << 30)
+        pod = _pod_in("a", "p", cpu="5000m")
+        tt.note_bound([pod])
+        tt.note_bound([pod])  # relist re-echo of the same bind
+        assert tt.share("a") == 0.5
+        tt.note_unbound([pod])
+        assert tt.share("a") == 0.0
+        # a genuine re-bind after the unbind counts again
+        tt.note_bound([pod])
+        assert tt.share("a") == 0.5
+
+    def test_two_stacks_converge_to_cluster_truth(self):
+        server = APIServer()
+        s1 = self._stack(server, {"node-0", "node-1"})
+        s2 = self._stack(server, {"node-2", "node-3"})
+        try:
+            client = s1[0]
+            for i in range(4):
+                client.create_node(
+                    make_node(f"node-{i}")
+                    .capacity(cpu="10", memory="16Gi").obj()
+                )
+            for i in range(4):
+                p = _pod_in(
+                    "tenant-a" if i % 2 == 0 else "tenant-b",
+                    f"b{i}", cpu="2000m",
+                )
+                p.spec.node_name = f"node-{i}"
+                client.create_pod(p)
+            for _c, informers, _s in (s1, s2):
+                informers.pump()
+            views = []
+            for _c, _i, sched in (s1, s2):
+                tt = sched.tenant_shares
+                tt.refresh_capacity(None)
+                views.append(
+                    tt.usage_and_caps(["tenant-a", "tenant-b"])
+                )
+            assert views[0] == views[1], (
+                "both stacks must see identical cluster-wide usage"
+            )
+            used, cap_cpu, _ = views[0]
+            assert used["tenant-a"] == (4000, used["tenant-a"][1])
+            assert used["tenant-b"][0] == 4000
+            assert cap_cpu == 40000
+            # node retirement shrinks the shared denominator everywhere
+            client.delete_node("node-3")
+            for _c, informers, _s in (s1, s2):
+                informers.pump()
+            for _c, _i, sched in (s1, s2):
+                tt = sched.tenant_shares
+                tt.refresh_capacity(None)
+                assert tt.usage_and_caps([])[1] == 30000
+        finally:
+            for _c, informers, sched in (s1, s2):
+                sched.stop()
+                informers.stop()

@@ -90,9 +90,23 @@ Prints ONE JSON line:
                          each; denominator = the pop+pack+solve+
                          download+commit stage-timer delta), plus the
                          raw per-span / per-mark op costs the tier-1
-                         self-time guard multiplies out}
+                         self-time guard multiplies out,
+   "spec_{serial,pipelined}_ms" / "spec_overlap_x" / "spec_launches" /
+   "spec_conflict_rewinds" / "spec_conflict_rewind_rate" /
+   "carry_full_bytes_{i32,i16}" / "carry_delta_bytes_{i32,i16}" /
+   "carry_link_ratio_x":
+                         the ISSUE-18 pipelined speculative dispatch:
+                         an identical seeded burst at 5k nodes through
+                         the RETIRED serial solve->commit path vs the
+                         double-buffered pipeline (committer overlapped
+                         with the next speculative solve), the rewind
+                         rate under a seeded bind-conflict sprinkle,
+                         and the resident-carry link/HBM payload int32
+                         vs packed int16 (full upload + steady delta
+                         slot)}
 
-Usage: python tools/bench_hotpath.py [--pods 10000] [--nodes 5000]
+Usage: python tools/bench_hotpath.py [bench_speculative]
+       [--pods 10000] [--nodes 5000]
 """
 
 from __future__ import annotations
@@ -1416,8 +1430,139 @@ def _time_mark_ops(rec, n_ops: int) -> float:
     return (time.perf_counter() - t0) / n_ops * 1e6
 
 
+def bench_speculative(num_nodes: int = 5000, num_pods: int = 2000):
+    """ISSUE-18 satellite: steady-state overlap microbench. Three full-
+    stack arms over identical seeded bursts at ``num_nodes`` nodes:
+
+    - serial: the RETIRED pre-pipeline path (every batch drains
+      solve -> download -> commit before the next solve launches);
+    - pipelined: the production path (committer thread overlapped with
+      the next batch's speculative solve against the shadow-expected
+      carry);
+    - conflict sprinkle: the pipelined path under seeded BIND_CONFLICT
+      faults -- reports how many speculative links the divergences
+      rewound (the cheap row-patch re-solve, not a drain).
+
+    Plus the carry-compression link/HBM payload at this node scale:
+    the int32 resident carry vs the packed-int16 'h' piece, for the
+    cold full upload and the steady DELTA_ROW_BUCKET slot."""
+    import random as _random
+    import time as _time
+
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.client.client import Client
+    from kubernetes_tpu.client.informer import InformerFactory
+    from kubernetes_tpu.robustness.faults import (
+        FaultInjector,
+        FaultPoint,
+        FaultProfile,
+        PointConfig,
+        install_injector,
+    )
+    from kubernetes_tpu.scheduler.batch import DELTA_ROW_BUCKET
+    from kubernetes_tpu.scheduler.scheduler import new_scheduler
+    from kubernetes_tpu.testing import make_node, make_pod
+
+    def run_arm(serial: bool, conflicts: bool):
+        server = APIServer()
+        client = Client(server)
+        informers = InformerFactory(server)
+        sched = new_scheduler(
+            client, informers, batch=True, max_batch=256,
+        )
+        if serial:
+            # the retired serial pipeline: same solver, no committer
+            # thread, no speculation
+            sched._solve_pipelined = sched._solve_and_commit
+        for i in range(num_nodes):
+            client.create_node(
+                make_node(f"sp{i}")
+                .capacity(cpu="64", memory="256Gi", pods=500)
+                .obj()
+            )
+        informers.start()
+        informers.wait_for_cache_sync()
+        sched.queue.run()
+        sched.warmup()  # compiles off the measured clock
+        if conflicts:
+            install_injector(FaultInjector(FaultProfile(
+                "bench-spec-conflicts", seed=0,
+                points={
+                    FaultPoint.BIND_CONFLICT: PointConfig(
+                        rate=0.02, max_fires=8
+                    ),
+                },
+            )))
+        rng = _random.Random(18)
+        pods = [
+            make_pod(f"sb-{i}")
+            .creation_timestamp(float(i))
+            .container(
+                cpu=f"{rng.choice([100, 200, 250])}m",
+                memory=f"{rng.choice([128, 256])}Mi",
+            )
+            .obj()
+            for i in range(num_pods)
+        ]
+        sched.start()
+        t0 = _time.perf_counter()
+        for lo in range(0, num_pods, 256):
+            client.create_pods_bulk(pods[lo:lo + 256])
+        deadline = _time.time() + 300
+        while _time.time() < deadline:
+            ps, _ = client.list_pods()
+            if sum(1 for p in ps if p.spec.node_name) >= num_pods:
+                break
+            _time.sleep(0.005)
+        elapsed_ms = (_time.perf_counter() - t0) * 1000.0
+        sched.wait_for_inflight_binds()
+        launches = sched.speculative_launches
+        rewinds = sched.speculative_rewinds
+        install_injector(None)
+        sched.stop()
+        informers.stop()
+        return elapsed_ms, launches, rewinds
+
+    serial_ms, _, _ = run_arm(serial=True, conflicts=False)
+    pipe_ms, launches, _ = run_arm(serial=False, conflicts=False)
+    _, c_launches, c_rewinds = run_arm(serial=False, conflicts=True)
+
+    # carry payloads: what the serving link ships (and HBM holds) per
+    # variant. int16 packs two values per int32 word ('h' piece), so
+    # the byte count is exactly half at even sizes
+    from kubernetes_tpu.tensors.node_tensor import ResourceDims
+
+    r = ResourceDims().num_dims
+    full_i32 = num_nodes * (r + 2) * 4
+    full_i16 = num_nodes * (r + 2) * 2
+    delta_i32 = DELTA_ROW_BUCKET * (r + 2) * 4
+    delta_i16 = DELTA_ROW_BUCKET * (r + 2) * 2
+    return {
+        "spec_serial_ms": serial_ms,
+        "spec_pipelined_ms": pipe_ms,
+        "spec_overlap_x": serial_ms / pipe_ms if pipe_ms else 0.0,
+        "spec_launches": int(launches),
+        "spec_conflict_launches": int(c_launches),
+        "spec_conflict_rewinds": int(c_rewinds),
+        "spec_conflict_rewind_rate": (
+            c_rewinds / c_launches if c_launches else 0.0
+        ),
+        "carry_full_bytes_i32": full_i32,
+        "carry_full_bytes_i16": full_i16,
+        "carry_delta_bytes_i32": delta_i32,
+        "carry_delta_bytes_i16": delta_i16,
+        "carry_link_ratio_x": full_i32 / full_i16,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "which", nargs="?", default=None,
+        choices=(None, "bench_speculative"),
+        help="run ONLY the named bench and print its record "
+             "(default: the full microbench suite)",
+    )
     ap.add_argument("--pods", type=int, default=10000)
     ap.add_argument("--nodes", type=int, default=5000)
     ap.add_argument(
@@ -1452,6 +1597,16 @@ def main() -> None:
             os.environ.get("XLA_FLAGS", "")
             + f" --xla_force_host_platform_device_count={args.mesh_devices}"
         ).strip()
+
+    if args.which == "bench_speculative":
+        spec = bench_speculative(args.nodes)
+        record = {"metric": "bench_speculative", "nodes": args.nodes}
+        record.update({
+            k: (v if isinstance(v, int) else round(v, 3))
+            for k, v in spec.items()
+        })
+        print(json.dumps(record))
+        return
 
     from kubernetes_tpu.testing import make_pod
 
@@ -1546,6 +1701,12 @@ def main() -> None:
         {
             k: (v if isinstance(v, int) else round(v, 2))
             for k, v in bisect.items()
+        }
+    )
+    record.update(
+        {
+            k: (v if isinstance(v, int) else round(v, 3))
+            for k, v in bench_speculative(args.nodes).items()
         }
     )
     print(json.dumps(record))

@@ -121,7 +121,7 @@ def probe_native_extension(base_dir: str | None = None) -> List[Tuple[str, str]]
         # .so missing entry points would half-run the plane)
         for name in exported + (
             "ingest_decode", "ingest_apply", "ingest_stamp",
-            "pack_gather", "queue_shape",
+            "pack_gather", "queue_shape", "mirror_scatter",
         ):
             if getattr(native.hotpath, name, None) is None:
                 failures.append((
